@@ -1,0 +1,102 @@
+"""Benchmark entry point: prints ONE JSON line for the driver.
+
+Current benchmark: MNIST-MLP training throughput (BASELINE config #1) on the
+available device.  ``vs_baseline`` compares against a plain un-jitted
+layer-by-layer JAX implementation of the same model (the stand-in for the
+reference's per-op task-launch execution until reference numbers exist).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_mlp_train(steps: int = 50, batch: int = 64):
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+    model = FFModel(FFConfig(batch_size=batch, learning_rate=0.05))
+    x = model.create_tensor((batch, 784))
+    h = model.dense(x, 512, activation="relu")
+    h = model.dense(h, 512, activation="relu")
+    out = model.softmax(model.dense(h, 10))
+    model.compile(optimizer=SGDOptimizer(lr=0.05, momentum=0.9))
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch, 784).astype(np.float32)
+    y = rng.randint(0, 10, size=batch).astype(np.int32)
+    tid = model.graph.input_tids[0]
+    xb, yb = jnp.asarray(X), jnp.asarray(y)
+    key = jax.random.PRNGKey(0)
+
+    # warmup/compile
+    p, s = model.params, model.opt_state
+    p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def bench_baseline_unjitted(steps: int = 10, batch: int = 64):
+    """Layer-by-layer eager JAX: what per-op dispatch (the reference's
+    task-per-op model) costs without whole-graph compilation."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(rng, 3)
+    w1 = jax.random.normal(k1, (784, 512)) * 0.05
+    w2 = jax.random.normal(k2, (512, 512)) * 0.05
+    w3 = jax.random.normal(k3, (512, 10)) * 0.05
+    b1 = jnp.zeros(512)
+    b2 = jnp.zeros(512)
+    b3 = jnp.zeros(10)
+    params = [w1, b1, w2, b2, w3, b3]
+    X = jnp.asarray(np.random.RandomState(0).randn(batch, 784), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, batch))
+
+    def loss_fn(params):
+        w1, b1, w2, b2, w3, b3 = params
+        h = jnp.maximum(X @ w1 + b1, 0)
+        h = jnp.maximum(h @ w2 + b2, 0)
+        logits = h @ w3 + b3
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+    grad_fn = jax.grad(loss_fn)  # eager, not jitted
+    g = grad_fn(params)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g = grad_fn(params)
+        params = [p - 0.05 * gi for p, gi in zip(params, g)]
+    jax.block_until_ready(params[0])
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main():
+    ours = bench_mlp_train()
+    base = bench_baseline_unjitted()
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_train_throughput",
+                "value": round(ours, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(ours / base, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""flexflow_tpu — a TPU-native deep-learning framework with FlexFlow's
+capabilities: an explicit parallel-computation-graph IR, Unity-style
+auto-parallelization search, and a FlexFlow-Serve-equivalent LLM serving
+runtime — built on JAX/XLA/Pallas, no CUDA/NCCL/Legion anywhere.
+
+Reference framework: anmolpau/FlexFlow (see SURVEY.md at repo root).
+"""
+
+from .config import FFConfig
+from .model import FFModel
+from .parallel.mesh import make_mesh, data_parallel_strategy
+from .training.optimizer import SGDOptimizer, AdamOptimizer
+from .training import loss as losses
+from .training import metrics as metrics
+from .training.initializer import (
+    GlorotUniform,
+    ZeroInitializer,
+    OneInitializer,
+    UniformInitializer,
+    NormInitializer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFConfig",
+    "FFModel",
+    "make_mesh",
+    "data_parallel_strategy",
+    "SGDOptimizer",
+    "AdamOptimizer",
+    "losses",
+    "metrics",
+    "GlorotUniform",
+    "ZeroInitializer",
+    "OneInitializer",
+    "UniformInitializer",
+    "NormInitializer",
+]

@@ -1,0 +1,95 @@
+"""FFConfig: global configuration + FlexFlow-style CLI flag parsing.
+
+Reference: ``include/flexflow/config.h`` / ``FFConfig::parse_args`` in
+``src/runtime/model.cc`` — Legion-style argv (``-ll:gpu``, ``-b``, ``-e``,
+``--budget``, ``--only-data-parallel``, ``--import``/``--export``).  Device
+enumeration (``FFHandler`` per-GPU cuDNN handles) collapses to
+``jax.devices()`` + a mesh spec; there is nothing to initialize per-device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # training loop
+    batch_size: int = 64
+    epochs: int = 1
+    learning_rate: float = 0.01
+
+    # machine: mesh axis name -> size; None = one axis "dp" over all devices
+    mesh_shape: Optional[Dict[str, int]] = None
+    num_devices: Optional[int] = None  # cap the device count (None = all)
+
+    # Unity-style search
+    search_budget: int = 0          # 0 = no search (use default/imported strategy)
+    search_alpha: float = 0.05      # MCMC temperature-ish factor
+    only_data_parallel: bool = False
+    import_strategy_file: Optional[str] = None
+    export_strategy_file: Optional[str] = None
+
+    # numerics
+    compute_dtype: str = "float32"
+
+    # profiling
+    profiling: bool = False
+    seed: int = 0
+
+    @staticmethod
+    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        import sys
+
+        argv = list(sys.argv[1:] if argv is None else argv)
+        cfg = FFConfig()
+        i = 0
+
+        def take() -> str:
+            nonlocal i
+            i += 1
+            return argv[i - 1]
+
+        while i < len(argv):
+            a = take()
+            if a in ("-b", "--batch-size"):
+                cfg.batch_size = int(take())
+            elif a in ("-e", "--epochs"):
+                cfg.epochs = int(take())
+            elif a in ("-lr", "--learning-rate"):
+                cfg.learning_rate = float(take())
+            elif a == "--budget" or a == "--search-budget":
+                cfg.search_budget = int(take())
+            elif a == "--search-alpha":
+                cfg.search_alpha = float(take())
+            elif a == "--only-data-parallel":
+                cfg.only_data_parallel = True
+            elif a == "--import" or a == "--import-strategy":
+                cfg.import_strategy_file = take()
+            elif a == "--export" or a == "--export-strategy":
+                cfg.export_strategy_file = take()
+            elif a == "--mesh":
+                # e.g. --mesh dp=4,tp=2
+                cfg.mesh_shape = {}
+                for part in take().split(","):
+                    k, v = part.split("=")
+                    cfg.mesh_shape[k.strip()] = int(v)
+            elif a in ("-ll:gpu", "-ll:tpu", "--devices"):
+                cfg.num_devices = int(take())
+            elif a == "--dtype":
+                cfg.compute_dtype = take()
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--seed":
+                cfg.seed = int(take())
+            # unknown flags are ignored (Legion-style tolerance)
+        return cfg
+
+    def devices(self):
+        devs = jax.devices()
+        if self.num_devices is not None:
+            devs = devs[: self.num_devices]
+        return devs

@@ -1,0 +1,159 @@
+"""Operator base class + registry.
+
+The TPU-native analogue of FlexFlow's ``Op``/``OpMeta``/task trio (reference:
+``include/flexflow/operator.h``, ``src/ops/*``).  Where a FlexFlow op carries
+``init_task``/``forward_task``/``backward_task`` CUDA kernels, a TPU op carries
+one pure-JAX ``lower`` function (XLA autodiff supplies the backward) plus a
+*sharding rule*: the declarative description of which logical dims the op can
+be parallelized over, replacing per-op ``MachineView`` handling.
+
+Sharding rules use an einsum-like notation.  Each op exposes
+
+* ``parallel_dims()`` — named, shardable logical dims with their (tensor, dim)
+  bindings, e.g. Linear: ``{"sample": [(in0,0)], "channel_out": [(w,1),(out,-1)],
+  "channel_in": [(in0,-1),(w,0)]}`` — the SOAP dimensions of the MLSys'19 paper.
+* ``apply_config(config, mesh)`` — given ``{parallel_dim_name: (mesh axes)}``,
+  produce required input/param shardings and resulting output shardings
+  (including partial-sum marking for contracted dims).
+
+The PCG normalizer then inserts explicit parallel ops wherever a producer's
+sharding differs from a consumer's requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .graph import ParamSpec, TensorSpec
+from .sharding import TensorSharding
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Runtime context handed to ``Op.lower``.
+
+    mode: "spmd"  — arrays are global; GSPMD handles comm (default training path)
+          "local" — arrays are per-device shards inside shard_map; parallel ops
+                    lower to explicit lax collectives (serve / manual path)
+    """
+
+    mode: str = "spmd"
+    mesh: Any = None
+    training: bool = False
+    rng: Optional[jax.Array] = None
+    config: Optional[Dict[str, Tuple[str, ...]]] = None
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def fold_rng(self, salt: int) -> Optional[jax.Array]:
+        if self.rng is None:
+            return None
+        return jax.random.fold_in(self.rng, salt)
+
+
+@dataclasses.dataclass
+class ShardingSolution:
+    """Output of ``Op.apply_config``: what the op needs and what it produces."""
+
+    inputs: List[TensorSharding]          # required sharding per input tensor
+    outputs: List[TensorSharding]         # produced sharding per output tensor
+    params: Dict[str, TensorSharding] = dataclasses.field(default_factory=dict)
+
+
+class Op:
+    """Base operator. Subclasses set ``type_name`` and implement the hooks."""
+
+    type_name: str = "op"
+
+    # ---- shapes -------------------------------------------------------
+    def infer_shapes(self, in_specs: List[TensorSpec]) -> List[TensorSpec]:
+        raise NotImplementedError
+
+    # ---- weights ------------------------------------------------------
+    def params(self) -> List[ParamSpec]:
+        return []
+
+    # ---- compute ------------------------------------------------------
+    def lower(
+        self,
+        ctx: OpContext,
+        inputs: List[jax.Array],
+        params: Dict[str, jax.Array],
+    ) -> List[jax.Array]:
+        raise NotImplementedError
+
+    # ---- parallelization ----------------------------------------------
+    def parallel_dims(self, in_specs: List[TensorSpec]) -> Dict[str, int]:
+        """Named shardable parallel dims -> global extent.
+
+        Default: ops with a leading sample/batch dim on input 0 expose it.
+        """
+        if in_specs and in_specs[0].ndim >= 1:
+            return {"sample": in_specs[0].shape[0]}
+        return {}
+
+    def apply_config(
+        self,
+        config: Dict[str, Tuple[str, ...]],
+        in_specs: List[TensorSpec],
+        mesh: Any,
+        in_shardings: Optional[List[Optional[TensorSharding]]] = None,
+    ) -> ShardingSolution:
+        """Map a parallel config to concrete tensor shardings.
+
+        ``in_shardings`` carries the producers' shardings (None for graph
+        inputs) so propagation-style ops can adopt them instead of forcing a
+        reshard; ops may ignore it.
+
+        Default implementation: "sample" shards dim 0 of every input and every
+        output; params replicated. Works for elementwise-ish ops.
+        """
+        sample_axes = tuple(config.get("sample", ()))
+        out_specs = self.infer_shapes(list(in_specs))
+        ins = []
+        for s in in_specs:
+            sh = TensorSharding.replicated(s.ndim)
+            if sample_axes and s.ndim >= 1:
+                sh = sh.with_dim(0, sample_axes)
+            ins.append(sh)
+        outs = []
+        for s in out_specs:
+            sh = TensorSharding.replicated(s.ndim)
+            if sample_axes and s.ndim >= 1:
+                sh = sh.with_dim(0, sample_axes)
+            outs.append(sh)
+        return ShardingSolution(inputs=ins, outputs=outs)
+
+    # ---- cost hints (used by the simulator) ---------------------------
+    def flops(self, in_specs: List[TensorSpec]) -> int:
+        """Approximate forward FLOPs; default: elementwise over output."""
+        out = self.infer_shapes(list(in_specs))
+        return sum(s.size for s in out)
+
+    def is_parallel_op(self) -> bool:
+        return False
+
+    def attr_signature(self) -> Tuple:
+        """Hashable signature of op attributes (for cost caching)."""
+        items = []
+        for k, v in sorted(vars(self).items()):
+            if isinstance(v, (int, float, str, bool, tuple, type(None))):
+                items.append((k, v))
+        return (self.type_name, tuple(items))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}"
+
+
+# ---------------------------------------------------------------------------
+# registry (op type name -> class), for strategy/serialization round-trips
+# ---------------------------------------------------------------------------
+OP_REGISTRY: Dict[str, type] = {}
+
+
+def register_op(cls):
+    OP_REGISTRY[cls.type_name] = cls
+    return cls

@@ -1,0 +1,266 @@
+"""Parallel Computation Graph: Layer graph + mesh + per-op parallel configs.
+
+The TPU-native analogue of FlexFlow's PCG (reference: ``src/runtime/graph.cc``,
+``include/flexflow/graph.h``).  A FlexFlow PCG binds each op to a
+``MachineView`` and each tensor to ``ParallelDim`` degrees, and reifies
+communication as parallel-op nodes.  Here:
+
+* the machine is a ``jax.sharding.Mesh`` with named axes,
+* each op gets a *parallel config* ``{parallel_dim_name: (mesh axes)}``
+  (the searchable object — the analogue of a MachineView assignment),
+* :meth:`PCG.plan` propagates shardings through the graph and inserts explicit
+  parallel ops (Repartition/Combine/Replicate/Reduction/AllReduce/AllToAll)
+  wherever a producer's sharding differs from a consumer's requirement —
+  the analogue of Unity's parallelization substitutions being materialized.
+
+The resulting :class:`Plan` is what both the interpreter (execution) and the
+simulator (costing) consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from jax.sharding import Mesh
+
+from .graph import Graph, Node, TensorSpec
+from .op import Op, ShardingSolution
+from .sharding import TensorSharding
+from ..parallel.parallel_ops import ParallelOp, reshard_path
+
+Config = Dict[str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass
+class Step:
+    """One executable step of a planned PCG (op or parallel op)."""
+
+    node: Node                      # original node, or synthetic for parallel ops
+    in_vids: List[int]              # plan-local value ids consumed
+    out_vids: List[int]             # plan-local value ids produced
+    in_shardings: List[TensorSharding]
+    out_shardings: List[TensorSharding]
+    in_specs: List[TensorSpec]
+    out_specs: List[TensorSpec]
+    config: Config = dataclasses.field(default_factory=dict)
+    is_parallel: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+@dataclasses.dataclass
+class Plan:
+    """Fully-resolved execution plan: steps + boundary shardings."""
+
+    mesh: Mesh
+    steps: List[Step]
+    input_vids: Dict[int, int]                 # graph input tid -> vid
+    output_vids: List[int]                     # vids of graph outputs
+    input_shardings: Dict[int, TensorSharding]  # graph input tid -> sharding
+    output_shardings: List[TensorSharding]
+    param_shardings: Dict[str, Dict[str, TensorSharding]]  # node -> pname -> sh
+    value_specs: Dict[int, TensorSpec]
+    value_shardings: Dict[int, TensorSharding]
+
+    def pretty(self) -> str:
+        lines = [f"Plan over mesh {dict(self.mesh.shape)}:"]
+        for s in self.steps:
+            tag = "comm" if s.is_parallel else "op  "
+            ins = ", ".join(
+                f"v{v}:{sh}" for v, sh in zip(s.in_vids, s.in_shardings)
+            )
+            outs = ", ".join(
+                f"v{v}:{sh}" for v, sh in zip(s.out_vids, s.out_shardings)
+            )
+            cfg = f" cfg={s.config}" if s.config else ""
+            lines.append(f"  [{tag}] {s.name}: ({ins}) -> ({outs}){cfg}")
+        return "\n".join(lines)
+
+
+class PCG:
+    """A Layer graph bound to a mesh with per-op parallel configs."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        mesh: Mesh,
+        configs: Optional[Dict[str, Config]] = None,
+        input_shardings: Optional[Dict[int, TensorSharding]] = None,
+        output_tids: Optional[List[int]] = None,
+    ):
+        self.graph = graph
+        self.mesh = mesh
+        self.configs: Dict[str, Config] = dict(configs or {})
+        self.input_shardings = dict(input_shardings or {})
+        if output_tids is None:
+            consumed = {t for n in graph.nodes for t in n.inputs}
+            output_tids = [
+                t
+                for n in graph.nodes
+                for t in n.outputs
+                if t not in consumed
+            ]
+        self.output_tids = output_tids
+
+    # ------------------------------------------------------------------
+    def with_configs(self, configs: Dict[str, Config]) -> "PCG":
+        return PCG(
+            self.graph, self.mesh, configs, self.input_shardings, self.output_tids
+        )
+
+    def default_input_sharding(self, tid: int, cons_req: TensorSharding) -> TensorSharding:
+        """Graph inputs adopt their first consumer's requirement (so batches
+        arrive already sharded instead of being resharded on-device)."""
+        return self.input_shardings.get(tid, cons_req)
+
+    # ------------------------------------------------------------------
+    def plan(self) -> Plan:
+        g = self.graph
+        mesh = self.mesh
+        next_vid = [0]
+
+        def new_vid() -> int:
+            next_vid[0] += 1
+            return next_vid[0] - 1
+
+        value_specs: Dict[int, TensorSpec] = {}
+        value_shardings: Dict[int, TensorSharding] = {}
+        tid_to_vid: Dict[int, int] = {}
+        steps: List[Step] = []
+        input_vids: Dict[int, int] = {}
+        input_shardings: Dict[int, TensorSharding] = {}
+        param_shardings: Dict[str, Dict[str, TensorSharding]] = {}
+        pending_inputs: Dict[int, TensorSpec] = {
+            tid: g.spec(tid) for tid in g.input_tids
+        }
+
+        def materialize_input(tid: int, req: TensorSharding) -> int:
+            spec = pending_inputs.pop(tid)
+            sh = self.default_input_sharding(tid, req)
+            vid = new_vid()
+            tid_to_vid[tid] = vid
+            value_specs[vid] = spec
+            value_shardings[vid] = sh
+            input_vids[tid] = vid
+            input_shardings[tid] = sh
+            return vid
+
+        def reshard_to(vid: int, want: TensorSharding, base_name: str) -> int:
+            have = value_shardings[vid]
+            if (tuple(have.dims), have.partial_axes) == (
+                tuple(want.dims),
+                want.partial_axes,
+            ):
+                return vid
+            for pop in reshard_path(have, want, mesh):
+                spec = value_specs[vid]
+                out_sh = pop.transform_sharding(value_shardings[vid], mesh)
+                nvid = new_vid()
+                nname = g.unique_name(f"{base_name}.{pop.type_name}")
+                synth = Node(-1, nname, pop, [], [])
+                steps.append(
+                    Step(
+                        node=synth,
+                        in_vids=[vid],
+                        out_vids=[nvid],
+                        in_shardings=[value_shardings[vid]],
+                        out_shardings=[out_sh],
+                        in_specs=[spec],
+                        out_specs=[spec],
+                        is_parallel=True,
+                    )
+                )
+                value_specs[nvid] = spec
+                value_shardings[nvid] = out_sh
+                vid = nvid
+            return vid
+
+        for node in g.topo_order():
+            in_specs = [g.spec(t) for t in node.inputs]
+            config = self.configs.get(node.name, {})
+            producer_shs: List[Optional[TensorSharding]] = []
+            for t in node.inputs:
+                if t in tid_to_vid:
+                    producer_shs.append(value_shardings[tid_to_vid[t]])
+                else:
+                    producer_shs.append(None)
+            sol: ShardingSolution = node.op.apply_config(
+                config, in_specs, mesh, producer_shs
+            )
+            # validate solution
+            out_specs = [g.spec(t) for t in node.outputs]
+            for sh, spec in zip(sol.inputs, in_specs):
+                sh.validate(spec.shape, mesh)
+            for sh, spec in zip(sol.outputs, out_specs):
+                sh.validate(spec.shape, mesh)
+
+            in_vids = []
+            for t, req in zip(node.inputs, sol.inputs):
+                if t in pending_inputs:
+                    vid = materialize_input(t, req)
+                else:
+                    vid = tid_to_vid[t]
+                vid = reshard_to(vid, req, node.name)
+                in_vids.append(vid)
+
+            out_vids = []
+            for t, sh, spec in zip(node.outputs, sol.outputs, out_specs):
+                vid = new_vid()
+                tid_to_vid[t] = vid
+                value_specs[vid] = spec
+                value_shardings[vid] = sh
+                out_vids.append(vid)
+
+            if sol.params:
+                param_shardings[node.name] = dict(sol.params)
+            else:
+                ps = node.op.params()
+                if ps:
+                    param_shardings[node.name] = {
+                        p.name: TensorSharding.replicated(p.spec.ndim) for p in ps
+                    }
+
+            steps.append(
+                Step(
+                    node=node,
+                    in_vids=in_vids,
+                    out_vids=out_vids,
+                    in_shardings=[value_shardings[v] for v in in_vids],
+                    out_shardings=list(sol.outputs),
+                    in_specs=in_specs,
+                    out_specs=out_specs,
+                    config=config,
+                )
+            )
+
+        # unconsumed graph inputs (e.g. labels fed straight to loss): replicated
+        for tid in list(pending_inputs):
+            materialize_input(tid, TensorSharding.replicated(g.spec(tid).ndim))
+
+        # graph outputs: clear partial sums so callers see full values
+        output_vids = []
+        output_shardings = []
+        for t in self.output_tids:
+            vid = tid_to_vid[t]
+            sh = value_shardings[vid]
+            if sh.partial_axes:
+                want = TensorSharding(sh.dims, frozenset())
+                vid = reshard_to(vid, want, f"out_t{t}")
+                sh = value_shardings[vid]
+            output_vids.append(vid)
+            output_shardings.append(sh)
+
+        return Plan(
+            mesh=mesh,
+            steps=steps,
+            input_vids=input_vids,
+            output_vids=output_vids,
+            input_shardings=input_shardings,
+            output_shardings=output_shardings,
+            param_shardings=param_shardings,
+            value_specs=value_specs,
+            value_shardings=value_shardings,
+        )

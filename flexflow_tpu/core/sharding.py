@@ -1,0 +1,172 @@
+"""Per-tensor sharding annotations over a named device mesh.
+
+This is the TPU-native analogue of FlexFlow's ``ParallelTensor``/``ParallelDim``
+machinery (reference: ``include/flexflow/parallel_tensor.h`` — per-dimension
+partition *degree* + replication flags, bound to a ``MachineView``).  On TPU the
+"machine view" is a ``jax.sharding.Mesh`` and a per-dimension assignment of
+mesh axis names; the partition degree of a dimension is the product of the
+sizes of the mesh axes assigned to it.
+
+Three orthogonal properties describe how a global logical tensor lives on the
+mesh:
+
+* ``dims[i].axes`` — mesh axes that shard logical dimension ``i``
+  (FlexFlow: ``ParallelDim::degree`` on a non-replica dim).
+* replication — any mesh axis not referenced by ``dims`` or ``partial_axes``
+  implicitly replicates the tensor (FlexFlow: replica dims).
+* ``partial_axes`` — mesh axes over which the values are *partial sums* that
+  must be reduced before the mathematical value is materialized (FlexFlow:
+  the state consumed by the ``Reduction``/``AllReduce`` parallel ops).
+  GSPMD has no user-visible notion of this, which is exactly why the PCG
+  reifies it: the Unity-style search must see and cost the pending reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class DimSharding:
+    """Sharding of one logical tensor dimension: the mesh axes that split it."""
+
+    axes: Tuple[str, ...] = ()
+
+    def degree(self, mesh_shape: dict) -> int:
+        d = 1
+        for a in self.axes:
+            d *= mesh_shape[a]
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSharding:
+    """Full sharding annotation for one PCG tensor.
+
+    ``dims`` has one entry per logical dimension.  ``partial_axes`` marks mesh
+    axes over which the tensor is an unreduced partial sum.
+    """
+
+    dims: Tuple[DimSharding, ...]
+    partial_axes: frozenset = frozenset()
+
+    # ---- constructors -------------------------------------------------
+    @staticmethod
+    def replicated(ndim: int) -> "TensorSharding":
+        return TensorSharding(tuple(DimSharding() for _ in range(ndim)))
+
+    @staticmethod
+    def from_axes(
+        ndim: int,
+        axis_map: Optional[dict] = None,
+        partial: Iterable[str] = (),
+    ) -> "TensorSharding":
+        """axis_map: {dim_index: mesh_axis_name or tuple of names}."""
+        axis_map = axis_map or {}
+        dims = []
+        for i in range(ndim):
+            a = axis_map.get(i, ())
+            if isinstance(a, str):
+                a = (a,)
+            dims.append(DimSharding(tuple(a)))
+        return TensorSharding(tuple(dims), frozenset(partial))
+
+    # ---- queries ------------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    def sharded_axes(self) -> Tuple[str, ...]:
+        out = []
+        for d in self.dims:
+            out.extend(d.axes)
+        return tuple(out)
+
+    def used_axes(self) -> frozenset:
+        return frozenset(self.sharded_axes()) | self.partial_axes
+
+    def is_fully_replicated(self) -> bool:
+        return not self.used_axes()
+
+    def dim_degree(self, dim: int, mesh: Mesh) -> int:
+        return self.dims[dim].degree(dict(mesh.shape))
+
+    def local_shape(self, global_shape: Sequence[int], mesh: Mesh) -> Tuple[int, ...]:
+        """Per-device shard shape (shard_map body sees this)."""
+        shape = []
+        for size, d in zip(global_shape, self.dims):
+            deg = d.degree(dict(mesh.shape))
+            if size % deg != 0:
+                raise ValueError(
+                    f"dim of size {size} not divisible by degree {deg} "
+                    f"(axes {d.axes})"
+                )
+            shape.append(size // deg)
+        return tuple(shape)
+
+    def validate(self, global_shape: Sequence[int], mesh: Mesh) -> None:
+        if len(global_shape) != len(self.dims):
+            raise ValueError(
+                f"sharding rank {len(self.dims)} != tensor rank {len(global_shape)}"
+            )
+        seen = set()
+        for d in self.dims:
+            for a in d.axes:
+                if a not in mesh.shape:
+                    raise ValueError(f"unknown mesh axis {a!r}")
+                if a in seen:
+                    raise ValueError(f"mesh axis {a!r} used to shard two dims")
+                seen.add(a)
+        for a in self.partial_axes:
+            if a not in mesh.shape:
+                raise ValueError(f"unknown mesh axis {a!r} in partial_axes")
+            if a in seen:
+                raise ValueError(f"mesh axis {a!r} both shards a dim and is partial")
+        self.local_shape(global_shape, mesh)
+
+    # ---- conversion to JAX sharding machinery -------------------------
+    def partition_spec(self) -> PartitionSpec:
+        """PartitionSpec for GSPMD / shard_map in_specs.
+
+        Note: partial-ness is NOT representable in a PartitionSpec; callers on
+        the GSPMD path must ensure partial tensors never escape a jitted
+        computation un-reduced (the PCG normalizer guarantees this by inserting
+        Reduction/AllReduce nodes).
+        """
+        entries = []
+        for d in self.dims:
+            if len(d.axes) == 0:
+                entries.append(None)
+            elif len(d.axes) == 1:
+                entries.append(d.axes[0])
+            else:
+                entries.append(tuple(d.axes))
+        # trailing Nones are fine to keep; PartitionSpec handles them
+        return PartitionSpec(*entries)
+
+    def named_sharding(self, mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.partition_spec())
+
+    # ---- rewriting helpers (used by parallel ops / search) ------------
+    def with_dim(self, dim: int, axes: Tuple[str, ...]) -> "TensorSharding":
+        dims = list(self.dims)
+        dims[dim] = DimSharding(tuple(axes))
+        return TensorSharding(tuple(dims), self.partial_axes)
+
+    def without_partial(self, axes: Iterable[str]) -> "TensorSharding":
+        return TensorSharding(self.dims, self.partial_axes - frozenset(axes))
+
+    def with_partial(self, axes: Iterable[str]) -> "TensorSharding":
+        return TensorSharding(self.dims, self.partial_axes | frozenset(axes))
+
+    def __str__(self) -> str:
+        parts = []
+        for d in self.dims:
+            parts.append("x".join(d.axes) if d.axes else "-")
+        s = "[" + ",".join(parts) + "]"
+        if self.partial_axes:
+            s += "+partial(" + ",".join(sorted(self.partial_axes)) + ")"
+        return s

@@ -1,0 +1,485 @@
+"""FFModel: the graph-builder + compile + train-loop API.
+
+Reference: ``FFModel`` in ``src/runtime/model.cc`` / ``include/flexflow/
+model.h`` — one builder method per layer type, ``compile()`` (Layer graph ->
+PCG -> strategy -> executable), and the train-loop verbs
+``forward/backward/update`` which here collapse into a single jitted train
+step (XLA differentiates and fuses the whole PCG; there is no separate
+backward pass to orchestrate).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import FFConfig
+from .core.graph import Graph, Tensor, TensorSpec
+from .core.interpreter import build_forward, init_params, place_inputs
+from .core.pcg import PCG, Plan
+from .core.sharding import TensorSharding
+from .ops.elementwise import Cast, Dropout, ElementBinary, ElementUnary
+from .ops.embedding import Embedding
+from .ops.linear import BatchMatmul, Linear
+from .ops.norm import (
+    AddBiasResidualLayerNorm,
+    BatchNorm,
+    LayerNorm,
+    RMSNorm,
+    ResidualLayerNorm,
+    ResidualRMSNorm,
+    SigmoidSiluMulti,
+)
+from .ops.reduction import (
+    ArgMax,
+    ArgTopK,
+    BeamTopK,
+    Reduce,
+    Sampling,
+    Softmax,
+    TopK,
+)
+from .ops.shape import (
+    Concat,
+    Flat,
+    Gather,
+    Reshape,
+    Reverse,
+    Split,
+    Transpose,
+)
+from .parallel.mesh import data_parallel_strategy, make_mesh
+from .training import loss as loss_mod
+from .training import metrics as metrics_mod
+from .training.optimizer import Optimizer, SGDOptimizer
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None, mesh=None):
+        self.config = config or FFConfig()
+        self.graph = Graph()
+        self.mesh = mesh  # created at compile if None
+        self.pcg: Optional[PCG] = None
+        self.plan: Optional[Plan] = None
+        self.params = None
+        self.opt_state = None
+        self.optimizer: Optional[Optimizer] = None
+        self.loss_type: Optional[str] = None
+        self.metric_names: List[str] = []
+        self._forward = None
+        self._train_step = None
+        self._eval_fn = None
+        self._label_tid: Optional[int] = None
+        self._rng = jax.random.PRNGKey(self.config.seed)
+
+    # ------------------------------------------------------------------
+    # graph building (FFModel's one-method-per-layer API)
+    # ------------------------------------------------------------------
+    def create_tensor(self, shape: Sequence[int], dtype=jnp.float32) -> Tensor:
+        return self.graph.add_input(TensorSpec(tuple(shape), dtype))
+
+    def _add(self, op, inputs: Sequence[Tensor], name=None) -> List[Tensor]:
+        return self.graph.add_node(op, list(inputs), name)
+
+    def dense(self, x, out_dim, activation=None, use_bias=True, name=None,
+              kernel_initializer=None, bias_initializer=None, dtype=None):
+        op = Linear(out_dim, activation, use_bias,
+                    dtype=dtype or x.dtype,
+                    kernel_initializer=kernel_initializer,
+                    bias_initializer=bias_initializer)
+        return self._add(op, [x], name or "dense")[0]
+
+    def embedding(self, x, num_entries, out_dim, aggr="none", name=None,
+                  kernel_initializer=None, dtype=jnp.float32):
+        op = Embedding(num_entries, out_dim, aggr, dtype, kernel_initializer)
+        return self._add(op, [x], name or "embedding")[0]
+
+    def batch_matmul(self, a, b, a_transposed=False, b_transposed=False, name=None):
+        return self._add(BatchMatmul(a_transposed, b_transposed), [a, b],
+                         name or "batch_matmul")[0]
+
+    # elementwise unary
+    def relu(self, x, name=None):
+        return self._add(ElementUnary("relu"), [x], name or "relu")[0]
+
+    def gelu(self, x, name=None):
+        return self._add(ElementUnary("gelu"), [x], name or "gelu")[0]
+
+    def sigmoid(self, x, name=None):
+        return self._add(ElementUnary("sigmoid"), [x], name or "sigmoid")[0]
+
+    def tanh(self, x, name=None):
+        return self._add(ElementUnary("tanh"), [x], name or "tanh")[0]
+
+    def silu(self, x, name=None):
+        return self._add(ElementUnary("silu"), [x], name or "silu")[0]
+
+    def elu(self, x, name=None):
+        return self._add(ElementUnary("elu"), [x], name or "elu")[0]
+
+    def exp(self, x, name=None):
+        return self._add(ElementUnary("exp"), [x], name or "exp")[0]
+
+    def identity(self, x, name=None):
+        return self._add(ElementUnary("identity"), [x], name or "identity")[0]
+
+    def scalar_multiply(self, x, scalar, name=None):
+        return self._add(ElementUnary("scalar_multiply", scalar), [x],
+                         name or "scalar_multiply")[0]
+
+    def scalar_add(self, x, scalar, name=None):
+        return self._add(ElementUnary("scalar_add", scalar), [x],
+                         name or "scalar_add")[0]
+
+    def scalar_sub(self, x, scalar, name=None):
+        return self._add(ElementUnary("scalar_sub", scalar), [x],
+                         name or "scalar_sub")[0]
+
+    def scalar_truediv(self, x, scalar, name=None):
+        return self._add(ElementUnary("scalar_truediv", scalar), [x],
+                         name or "scalar_truediv")[0]
+
+    def pow(self, x, exponent, name=None):
+        return self._add(ElementUnary("pow", exponent), [x], name or "pow")[0]
+
+    # elementwise binary
+    def add(self, a, b, name=None):
+        return self._add(ElementBinary("add"), [a, b], name or "add")[0]
+
+    def subtract(self, a, b, name=None):
+        return self._add(ElementBinary("sub"), [a, b], name or "subtract")[0]
+
+    def multiply(self, a, b, name=None):
+        return self._add(ElementBinary("mul"), [a, b], name or "multiply")[0]
+
+    def divide(self, a, b, name=None):
+        return self._add(ElementBinary("div"), [a, b], name or "divide")[0]
+
+    def max(self, a, b, name=None):
+        return self._add(ElementBinary("max"), [a, b], name or "max")[0]
+
+    def min(self, a, b, name=None):
+        return self._add(ElementBinary("min"), [a, b], name or "min")[0]
+
+    def cast(self, x, dtype, name=None):
+        return self._add(Cast(dtype), [x], name or "cast")[0]
+
+    def dropout(self, x, rate, seed=0, name=None):
+        return self._add(Dropout(rate, seed), [x], name or "dropout")[0]
+
+    # normalization
+    def layer_norm(self, x, elementwise_affine=True, eps=1e-5, use_bias=True,
+                   name=None):
+        op = LayerNorm(x.shape[-1], elementwise_affine, eps, use_bias, x.dtype)
+        return self._add(op, [x], name or "layer_norm")[0]
+
+    def rms_norm(self, x, eps=1e-6, name=None):
+        return self._add(RMSNorm(x.shape[-1], eps, x.dtype), [x],
+                         name or "rms_norm")[0]
+
+    def residual_layer_norm(self, x, r1, r2=None, elementwise_affine=True,
+                            eps=1e-5, use_bias=True, name=None):
+        ins = [x, r1] + ([r2] if r2 is not None else [])
+        op = ResidualLayerNorm(x.shape[-1], r2 is not None,
+                               elementwise_affine, eps, use_bias, x.dtype)
+        return self._add(op, ins, name or "residual_layer_norm")
+
+    def add_bias_residual_layer_norm(self, x, residual, elementwise_affine=True,
+                                     eps=1e-5, use_bias=True, name=None):
+        op = AddBiasResidualLayerNorm(x.shape[-1], elementwise_affine, eps,
+                                      use_bias, x.dtype)
+        return self._add(op, [x, residual], name or "add_bias_residual_layer_norm")
+
+    def residual_rms_norm(self, x, residual, eps=1e-6, name=None):
+        op = ResidualRMSNorm(x.shape[-1], eps, x.dtype)
+        return self._add(op, [x, residual], name or "residual_rms_norm")
+
+    def sigmoid_silu_multi(self, x1, x2, name=None):
+        return self._add(SigmoidSiluMulti(), [x1, x2],
+                         name or "sigmoid_silu_multi")[0]
+
+    def batch_norm(self, x, relu=False, eps=1e-5, momentum=0.9, name=None):
+        op = BatchNorm(x.shape[1], relu, eps, momentum, x.dtype)
+        return self._add(op, [x], name or "batch_norm")[0]
+
+    # shape
+    def reshape(self, x, shape, name=None):
+        return self._add(Reshape(shape), [x], name or "reshape")[0]
+
+    def transpose(self, x, perm, name=None):
+        return self._add(Transpose(perm), [x], name or "transpose")[0]
+
+    def concat(self, tensors, axis, name=None):
+        return self._add(Concat(axis), list(tensors), name or "concat")[0]
+
+    def split(self, x, sizes, axis, name=None):
+        if isinstance(sizes, int):
+            n = x.shape[axis % len(x.shape)] // sizes
+            sizes = [n] * sizes
+        return self._add(Split(sizes, axis), [x], name or "split")
+
+    def gather(self, x, idx, axis, name=None):
+        return self._add(Gather(axis), [x, idx], name or "gather")[0]
+
+    def reverse(self, x, axis, name=None):
+        return self._add(Reverse(axis), [x], name or "reverse")[0]
+
+    def flat(self, x, name=None):
+        return self._add(Flat(), [x], name or "flat")[0]
+
+    # reductions / heads
+    def softmax(self, x, axis=-1, name=None):
+        return self._add(Softmax(axis), [x], name or "softmax")[0]
+
+    def reduce_sum(self, x, axes, keepdims=False, name=None):
+        return self._add(Reduce("sum", axes, keepdims), [x], name or "reduce_sum")[0]
+
+    def reduce_mean(self, x, axes, keepdims=False, name=None):
+        return self._add(Reduce("mean", axes, keepdims), [x], name or "reduce_mean")[0]
+
+    def argmax(self, x, name=None):
+        return self._add(ArgMax(), [x], name or "argmax")[0]
+
+    def top_k(self, x, k, sorted=True, name=None):
+        return self._add(TopK(k, sorted), [x], name or "top_k")
+
+    def arg_top_k(self, x, k, speculative_decoding=False, name=None):
+        return self._add(ArgTopK(k, speculative_decoding), [x], name or "arg_top_k")
+
+    def sampling(self, x, top_p=1.0, temperature=1.0, name=None):
+        return self._add(Sampling(top_p, temperature), [x], name or "sampling")[0]
+
+    def beam_top_k(self, x, max_beam_width, name=None):
+        return self._add(BeamTopK(max_beam_width), [x], name or "beam_top_k")
+
+    # attention (training); serve attention ops live in flexflow_tpu.serve
+    def multihead_attention(self, query, key, value, embed_dim, num_heads,
+                            kdim=None, vdim=None, dropout=0.0, use_bias=True,
+                            causal=False, name=None):
+        from .ops.attention import MultiHeadAttention
+
+        op = MultiHeadAttention(embed_dim, num_heads, kdim, vdim, dropout,
+                                use_bias, causal, dtype=query.dtype)
+        return self._add(op, [query, key, value], name or "multihead_attention")[0]
+
+    # convenience for conv nets
+    def conv2d(self, x, out_channels, kernel=(3, 3), stride=(1, 1),
+               padding="SAME", activation=None, use_bias=True, groups=1,
+               name=None):
+        from .ops.conv import Conv2D
+
+        op = Conv2D(out_channels, kernel, stride, padding, activation,
+                    use_bias, groups, dtype=x.dtype)
+        return self._add(op, [x], name or "conv2d")[0]
+
+    def pool2d(self, x, kernel=(2, 2), stride=(2, 2), padding="VALID",
+               pool_type="max", name=None):
+        from .ops.conv import Pool2D
+
+        op = Pool2D(kernel, stride, padding, pool_type)
+        return self._add(op, [x], name or "pool2d")[0]
+
+    # ------------------------------------------------------------------
+    # compile
+    # ------------------------------------------------------------------
+    def compile(
+        self,
+        optimizer: Optional[Optimizer] = None,
+        loss_type: str = loss_mod.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics: Sequence[str] = (),
+        strategy: Optional[Dict[str, Dict]] = None,
+        mode: str = "spmd",
+        outputs: Optional[Sequence[Tensor]] = None,
+    ):
+        """Lower Layer graph -> PCG with a strategy -> jitted step functions.
+
+        Strategy resolution order (mirrors FFModel::compile):
+        1. explicit ``strategy`` argument (op name -> parallel config),
+        2. imported strategy file (``--import``),
+        3. Unity-style search if ``search_budget > 0``,
+        4. data-parallel fallback (``--only-data-parallel`` or default).
+        """
+        cfg = self.config
+        if self.mesh is None:
+            self.mesh = make_mesh(cfg.mesh_shape, cfg.devices())
+        mesh = self.mesh
+
+        if strategy is None and cfg.import_strategy_file:
+            from .search.strategy import load_strategy
+
+            strategy = load_strategy(cfg.import_strategy_file)
+        if strategy is None and cfg.search_budget > 0 and not cfg.only_data_parallel:
+            from .search.search import graph_optimize
+
+            strategy = graph_optimize(
+                self.graph, mesh, budget=cfg.search_budget, alpha=cfg.search_alpha
+            )
+        if strategy is None:
+            strategy = data_parallel_strategy(self.graph, mesh)
+        if cfg.export_strategy_file:
+            from .search.strategy import save_strategy
+
+            save_strategy(cfg.export_strategy_file, strategy)
+
+        out_tids = [t.tid for t in outputs] if outputs else None
+        self.pcg = PCG(self.graph, mesh, strategy, output_tids=out_tids)
+        self.plan = self.pcg.plan()
+        self._forward = build_forward(self.plan, mode=mode)
+
+        self._rng, init_key = jax.random.split(self._rng)
+        self.params = init_params(self.graph, self.plan, init_key)
+
+        self.optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
+        self.loss_type = loss_type
+        self.metric_names = list(metrics)
+
+        trainable_mask = self._trainable_mask()
+        forward = self._forward
+        loss_type_ = self.loss_type
+        metric_names = self.metric_names
+        opt = self.optimizer
+
+        def train_step(params, opt_state, inputs, labels, rng):
+            def loss_fn(tr_params):
+                merged = _merge(params, tr_params, trainable_mask)
+                outs = forward(merged, inputs, rng=rng, training=True)
+                logits = outs[0]
+                return loss_mod.compute_loss(loss_type_, logits, labels), logits
+
+            tr_params = _filter(params, trainable_mask)
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                tr_params
+            )
+            new_tr, new_opt_state = opt.update(grads, opt_state, tr_params)
+            new_params = _merge(params, new_tr, trainable_mask)
+            mets = metrics_mod.compute_metrics(metric_names, logits, labels)
+            return new_params, new_opt_state, loss, mets
+
+        def eval_step(params, inputs, labels):
+            outs = forward(params, inputs, rng=None, training=False)
+            logits = outs[0]
+            loss = loss_mod.compute_loss(loss_type_, logits, labels)
+            mets = metrics_mod.compute_metrics(metric_names, logits, labels)
+            return loss, mets
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+        self._eval_fn = jax.jit(eval_step)
+        self.opt_state = self.optimizer.init_state(
+            _filter(self.params, trainable_mask)
+        )
+        return self
+
+    def _trainable_mask(self):
+        mask = {}
+        for name, ps in self.graph.param_specs().items():
+            mask[name] = {p.name: p.trainable for p in ps.values()}
+        return mask
+
+    # ------------------------------------------------------------------
+    # train / eval loops (FFModel::fit analog via the python frontends)
+    # ------------------------------------------------------------------
+    def _standardize_inputs(self, x) -> Dict[int, np.ndarray]:
+        tids = self.graph.input_tids
+        if isinstance(x, dict):
+            return {t.tid if isinstance(t, Tensor) else t: v for t, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return {tid: v for tid, v in zip(tids, x)}
+        return {tids[0]: x}
+
+    def fit(self, x, y, epochs: Optional[int] = None,
+            batch_size: Optional[int] = None, verbose: bool = True,
+            shuffle: bool = True):
+        assert self._train_step is not None, "call compile() first"
+        epochs = epochs or self.config.epochs
+        bs = batch_size or self.config.batch_size
+        inputs = self._standardize_inputs(x)
+        n = len(y)
+        history = []
+        for epoch in range(epochs):
+            self._rng, ek = jax.random.split(self._rng)
+            idx = np.random.permutation(n) if shuffle else np.arange(n)
+            losses, mets_acc = [], []
+            t0 = time.perf_counter()
+            for start in range(0, n - bs + 1, bs):
+                sel = idx[start : start + bs]
+                batch = {
+                    tid: jnp.asarray(v[sel]) for tid, v in inputs.items()
+                }
+                batch = place_inputs(self.plan, batch)
+                labels = jnp.asarray(y[sel])
+                ek, sk = jax.random.split(ek)
+                self.params, self.opt_state, loss, mets = self._train_step(
+                    self.params, self.opt_state, batch, labels, sk
+                )
+                losses.append(loss)
+                mets_acc.append(mets)
+            jax.block_until_ready(losses[-1])
+            dt = time.perf_counter() - t0
+            mean_loss = float(np.mean([float(l) for l in losses]))
+            mean_mets = {
+                k: float(np.mean([float(m[k]) for m in mets_acc]))
+                for k in (mets_acc[0] if mets_acc else {})
+            }
+            steps = len(losses)
+            history.append({"loss": mean_loss, **mean_mets})
+            if verbose:
+                print(
+                    f"epoch {epoch + 1}/{epochs}: loss={mean_loss:.4f} "
+                    + " ".join(f"{k}={v:.4f}" for k, v in mean_mets.items())
+                    + f" ({steps / dt:.1f} it/s, {steps * bs / dt:.0f} samples/s)"
+                )
+        return history
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        assert self._eval_fn is not None, "call compile() first"
+        bs = batch_size or self.config.batch_size
+        inputs = self._standardize_inputs(x)
+        n = len(y)
+        losses, mets_acc, counts = [], [], []
+        for start in range(0, n - bs + 1, bs):
+            batch = {
+                tid: jnp.asarray(v[start : start + bs])
+                for tid, v in inputs.items()
+            }
+            batch = place_inputs(self.plan, batch)
+            labels = jnp.asarray(y[start : start + bs])
+            loss, mets = self._eval_fn(self.params, batch, labels)
+            losses.append(float(loss))
+            mets_acc.append(mets)
+        out = {"loss": float(np.mean(losses))}
+        for k in self.metric_names:
+            out[k] = float(np.mean([float(m[k]) for m in mets_acc]))
+        return out
+
+    def forward(self, x, training: bool = False):
+        """Run the compiled PCG forward (global arrays in/out)."""
+        assert self._forward is not None, "call compile() first"
+        inputs = {
+            tid: jnp.asarray(v)
+            for tid, v in self._standardize_inputs(x).items()
+        }
+        inputs = place_inputs(self.plan, inputs)
+        outs = self._forward(self.params, inputs, rng=None, training=training)
+        return outs[0] if len(outs) == 1 else outs
+
+
+def _filter(params, mask):
+    out = {}
+    for name, sub in params.items():
+        m = mask.get(name, {})
+        kept = {k: v for k, v in sub.items() if m.get(k, True)}
+        if kept:
+            out[name] = kept
+    return out
+
+
+def _merge(params, tr_params, mask):
+    out = {}
+    for name, sub in params.items():
+        tr = tr_params.get(name, {})
+        out[name] = {k: tr.get(k, v) for k, v in sub.items()}
+    return out

@@ -1,0 +1,163 @@
+"""MultiHeadAttention (training path).
+
+Reference: ``src/ops/attention.cc/.cu`` (cuDNN multi-head attention).  On TPU
+the whole attention block is jnp einsums the MXU eats directly; heads are the
+tensor-parallel dim ("parameter" parallelism in SOAP terms): sharding heads
+shards all four projection weights, with the output projection row-parallel
+producing a partial sum — identical comm structure to Megatron and to what
+Unity discovers for the reference Transformer example.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import ParamSpec, TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+
+
+@register_op
+class MultiHeadAttention(Op):
+    type_name = "multihead_attention"
+
+    def __init__(
+        self,
+        embed_dim: int,
+        num_heads: int,
+        kdim: Optional[int] = None,
+        vdim: Optional[int] = None,
+        dropout: float = 0.0,
+        use_bias: bool = True,
+        causal: bool = False,
+        dtype=jnp.float32,
+    ):
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.kdim = int(kdim or embed_dim)
+        self.vdim = int(vdim or embed_dim)
+        self.dropout = float(dropout)
+        self.use_bias = bool(use_bias)
+        self.causal = bool(causal)
+        self.dtype = jnp.dtype(dtype).name
+        if self.embed_dim % self.num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.head_dim = self.embed_dim // self.num_heads
+
+    def infer_shapes(self, in_specs):
+        q = in_specs[0]
+        return [TensorSpec(q.shape[:-1] + (self.embed_dim,), jnp.dtype(self.dtype))]
+
+    def params(self):
+        d = jnp.dtype(self.dtype)
+        e, h, hd = self.embed_dim, self.num_heads, self.head_dim
+        ps = [
+            ParamSpec("wq", TensorSpec((e, h, hd), d)),
+            ParamSpec("wk", TensorSpec((self.kdim, h, hd), d)),
+            ParamSpec("wv", TensorSpec((self.vdim, h, hd), d)),
+            ParamSpec("wo", TensorSpec((h, hd, e), d)),
+        ]
+        if self.use_bias:
+            ps += [
+                ParamSpec("bq", TensorSpec((h, hd), d)),
+                ParamSpec("bk", TensorSpec((h, hd), d)),
+                ParamSpec("bv", TensorSpec((h, hd), d)),
+                ParamSpec("bo", TensorSpec((e,), d)),
+            ]
+        return ps
+
+    def lower(self, ctx, inputs, params):
+        q_in, k_in, v_in = inputs
+        acc = jnp.float32
+        q = jnp.einsum("bse,ehd->bshd", q_in, params["wq"],
+                       preferred_element_type=acc)
+        k = jnp.einsum("bse,ehd->bshd", k_in, params["wk"],
+                       preferred_element_type=acc)
+        v = jnp.einsum("bse,ehd->bshd", v_in, params["wv"],
+                       preferred_element_type=acc)
+        if self.use_bias:
+            q = q + params["bq"]
+            k = k + params["bk"]
+            v = v + params["bv"]
+        scale = 1.0 / np.sqrt(self.head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=acc) * scale
+        if self.causal:
+            qlen, klen = scores.shape[-2], scores.shape[-1]
+            mask = jnp.tril(jnp.ones((qlen, klen), bool))
+            scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if self.dropout > 0 and ctx.training and ctx.rng is not None:
+            keep = jax.random.bernoulli(ctx.rng, 1 - self.dropout, probs.shape)
+            probs = jnp.where(keep, probs / (1 - self.dropout), 0)
+        ctx_v = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                           preferred_element_type=acc)
+        out = jnp.einsum("bqhd,hde->bqe", ctx_v, params["wo"],
+                         preferred_element_type=acc)
+        partial_heads = bool(ctx.config and ctx.config.get("head"))
+        if self.use_bias:
+            bo = params["bo"]
+            if partial_heads and ctx.mode == "local" and ctx.mesh is not None:
+                idx = jnp.int32(0)
+                for a in ctx.config["head"]:
+                    idx = idx + jax.lax.axis_index(a)
+                bo = jnp.where(idx == 0, bo, jnp.zeros_like(bo))
+            out = out + bo
+        return [out.astype(self.dtype)]
+
+    def parallel_dims(self, in_specs):
+        return {"sample": in_specs[0].shape[0], "head": self.num_heads}
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        q, k, v = in_specs
+        sample = tuple(config.get("sample", ()))
+        head = tuple(config.get("head", ()))
+
+        def in_sh(spec):
+            sh = TensorSharding.replicated(spec.ndim)
+            if sample:
+                sh = sh.with_dim(0, sample)
+            return sh
+
+        out = self.infer_shapes([q, k, v])[0]
+        out_sh = TensorSharding.replicated(out.ndim)
+        if sample:
+            out_sh = out_sh.with_dim(0, sample)
+        if head:
+            out_sh = out_sh.with_partial(head)
+
+        params = {}
+        for w in ("wq", "wk", "wv"):
+            sh = TensorSharding.replicated(3)
+            if head:
+                sh = sh.with_dim(1, head)
+            params[w] = sh
+        wo_sh = TensorSharding.replicated(3)
+        if head:
+            wo_sh = wo_sh.with_dim(0, head)
+        params["wo"] = wo_sh
+        if self.use_bias:
+            for b in ("bq", "bk", "bv"):
+                sh = TensorSharding.replicated(2)
+                if head:
+                    sh = sh.with_dim(0, head)
+                params[b] = sh
+            params["bo"] = TensorSharding.replicated(1)
+        return ShardingSolution(
+            inputs=[in_sh(q), in_sh(k), in_sh(v)],
+            outputs=[out_sh],
+            params=params,
+        )
+
+    def flops(self, in_specs):
+        q, k, v = in_specs
+        b, sq = q.shape[0], q.shape[1]
+        sk = k.shape[1]
+        e, h, hd = self.embed_dim, self.num_heads, self.head_dim
+        proj = 2 * b * sq * e * h * hd * 3 + 2 * b * sq * h * hd * e
+        attn = 2 * b * h * sq * sk * hd * 2
+        return proj + attn

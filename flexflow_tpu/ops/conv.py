@@ -1,0 +1,175 @@
+"""Conv2D / Pool2D for the vision examples (AlexNet, ResNet, InceptionV3).
+
+Reference: ``src/ops/conv_2d.cc/.cu`` and ``pool_2d.cc/.cu`` (cuDNN).  NCHW
+layout matches the reference's API; XLA:TPU internally picks its own layout.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import ParamSpec, TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+from .elementwise import UNARY_FNS
+
+
+def _pair(x) -> Tuple[int, int]:
+    if isinstance(x, int):
+        return (x, x)
+    return tuple(x)
+
+
+def _out_size(size, k, s, pad):
+    if pad == "SAME":
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+@register_op
+class Conv2D(Op):
+    type_name = "conv2d"
+
+    def __init__(self, out_channels, kernel=(3, 3), stride=(1, 1),
+                 padding="SAME", activation=None, use_bias=True, groups=1,
+                 in_channels=None, dtype=jnp.float32,
+                 kernel_initializer=None, bias_initializer=None):
+        self.out_channels = int(out_channels)
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        if isinstance(padding, int):
+            padding = ((padding, padding), (padding, padding))
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+        self.groups = int(groups)
+        self.in_channels = in_channels
+        self.dtype = jnp.dtype(dtype).name
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]  # NCHW
+        n, c, h, w = x.shape
+        if self.in_channels is None:
+            self.in_channels = c
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        if isinstance(self.padding, str):
+            oh, ow = _out_size(h, kh, sh, self.padding), _out_size(w, kw, sw, self.padding)
+        else:
+            (pt, pb), (pl, pr) = self.padding
+            oh = (h + pt + pb - kh) // sh + 1
+            ow = (w + pl + pr - kw) // sw + 1
+        return [TensorSpec((n, self.out_channels, oh, ow), jnp.dtype(self.dtype))]
+
+    def params(self):
+        d = jnp.dtype(self.dtype)
+        ps = [
+            ParamSpec(
+                "kernel",
+                TensorSpec(
+                    (self.out_channels, self.in_channels // self.groups,
+                     *self.kernel),
+                    d,
+                ),
+                self.kernel_initializer,
+            )
+        ]
+        if self.use_bias:
+            ps.append(ParamSpec("bias", TensorSpec((self.out_channels,), d),
+                                self.bias_initializer))
+        return ps
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        y = jax.lax.conv_general_dilated(
+            x,
+            params["kernel"],
+            window_strides=self.stride,
+            padding=self.padding,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["bias"][None, :, None, None]
+        if self.activation:
+            y = UNARY_FNS[self.activation](y)
+        return [y.astype(self.dtype)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sample = tuple(config.get("sample", ()))
+        sh = TensorSharding.replicated(x.ndim)
+        if sample:
+            sh = sh.with_dim(0, sample)
+        out = self.infer_shapes([x])[0]
+        out_sh = TensorSharding.replicated(out.ndim)
+        if sample:
+            out_sh = out_sh.with_dim(0, sample)
+        return ShardingSolution(inputs=[sh], outputs=[out_sh])
+
+    def flops(self, in_specs):
+        out = self.infer_shapes(list(in_specs))[0]
+        kh, kw = self.kernel
+        return 2 * out.size * (self.in_channels // self.groups) * kh * kw
+
+
+@register_op
+class Pool2D(Op):
+    type_name = "pool2d"
+
+    def __init__(self, kernel=(2, 2), stride=(2, 2), padding="VALID",
+                 pool_type="max"):
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        if isinstance(padding, int):
+            padding = ((padding, padding), (padding, padding))
+        self.padding = padding
+        self.pool_type = pool_type
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        n, c, h, w = x.shape
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        if isinstance(self.padding, str):
+            oh, ow = _out_size(h, kh, sh, self.padding), _out_size(w, kw, sw, self.padding)
+        else:
+            (pt, pb), (pl, pr) = self.padding
+            oh = (h + pt + pb - kh) // sh + 1
+            ow = (w + pl + pr - kw) // sw + 1
+        return [TensorSpec((n, c, oh, ow), x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        if isinstance(self.padding, str):
+            pad = self.padding
+        else:
+            pad = ((0, 0), (0, 0)) + tuple(self.padding)
+        window = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        if self.pool_type == "max":
+            y = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, window, strides, pad
+            )
+        else:
+            y = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, window, strides, pad
+            ) / (kh * kw)
+        return [y.astype(x.dtype)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sample = tuple(config.get("sample", ()))
+        sh = TensorSharding.replicated(x.ndim)
+        if sample:
+            sh = sh.with_dim(0, sample)
+        return ShardingSolution(inputs=[sh], outputs=[sh])

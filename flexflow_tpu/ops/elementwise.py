@@ -1,0 +1,235 @@
+"""Elementwise operators: ElementUnary, ElementBinary, Cast, Dropout.
+
+Reference: ``src/ops/element_unary.cc/.cu``, ``element_binary.cc/.cu``,
+``cast.cc``, ``dropout.cc`` — one CUDA kernel per op there; here each is a
+jnp expression XLA fuses into neighbouring ops (the reference needs its
+``FusedOp`` machinery to get the same effect; see ``fused.py``).
+
+Sharding rule: elementwise ops are parallel in every dimension, so they
+*propagate* the producer's sharding.  Partial-sum inputs are only legal where
+linearity allows (scalar mul / add of identically-partial values); otherwise
+the op demands the reduction first, which the PCG normalizer materializes as
+an AllReduce node — this is exactly where FlexFlow's Unity places its
+AllReduce parallel op.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import TensorSpec
+from ..core.op import Op, OpContext, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+
+UNARY_FNS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "abs": jnp.abs,
+    "negative": jnp.negative,
+    "silu": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "identity": lambda x: x,
+}
+
+# f(sum_i x_i) == sum_i f(x_i) — safe to apply to partial-sum shards
+LINEAR_UNARY = {"identity", "negative", "scalar_multiply", "scalar_truediv"}
+
+
+def propagate(sh: Optional[TensorSharding], spec: TensorSpec) -> TensorSharding:
+    return sh if sh is not None else TensorSharding.replicated(spec.ndim)
+
+
+@register_op
+class ElementUnary(Op):
+    type_name = "element_unary"
+
+    def __init__(self, fn: str, scalar: Optional[float] = None):
+        if fn not in UNARY_FNS and not fn.startswith("scalar_") and fn != "pow":
+            raise ValueError(f"unknown unary fn {fn!r}")
+        self.fn = fn
+        self.scalar = scalar
+
+    def infer_shapes(self, in_specs):
+        return [in_specs[0]]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if self.fn == "scalar_add":
+            return [x + self.scalar]
+        if self.fn == "scalar_sub":
+            return [x - self.scalar]
+        if self.fn == "scalar_multiply":
+            return [x * self.scalar]
+        if self.fn == "scalar_truediv":
+            return [x / self.scalar]
+        if self.fn == "pow":
+            return [x ** self.scalar]
+        return [UNARY_FNS[self.fn](x)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        sh = propagate(in_shardings[0] if in_shardings else None, in_specs[0])
+        if sh.partial_axes and self.fn not in LINEAR_UNARY:
+            sh = TensorSharding(sh.dims, frozenset())  # demand reduction first
+        return ShardingSolution(inputs=[sh], outputs=[sh])
+
+    def flops(self, in_specs):
+        return in_specs[0].size
+
+
+@register_op
+class ElementBinary(Op):
+    type_name = "element_binary"
+
+    FNS = {
+        "add": jnp.add,
+        "sub": jnp.subtract,
+        "mul": jnp.multiply,
+        "div": jnp.divide,
+        "max": jnp.maximum,
+        "min": jnp.minimum,
+        "pow": jnp.power,
+    }
+
+    def __init__(self, fn: str):
+        if fn not in self.FNS:
+            raise ValueError(f"unknown binary fn {fn!r}")
+        self.fn = fn
+
+    def infer_shapes(self, in_specs):
+        a, b = in_specs
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        return [TensorSpec(tuple(shape), a.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        return [self.FNS[self.fn](inputs[0], inputs[1])]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        a_spec, b_spec = in_specs
+        a_sh = propagate(in_shardings[0] if in_shardings else None, a_spec)
+        b_sh = propagate(in_shardings[1] if in_shardings else None, b_spec)
+
+        # partial handling: add/sub of identically-partial values is linear;
+        # anything else needs full values.
+        if self.fn in ("add", "sub") and a_sh.partial_axes == b_sh.partial_axes:
+            partial = a_sh.partial_axes
+        else:
+            partial = frozenset()
+            if a_sh.partial_axes:
+                a_sh = TensorSharding(a_sh.dims, frozenset())
+            if b_sh.partial_axes:
+                b_sh = TensorSharding(b_sh.dims, frozenset())
+
+        out_ndim = max(a_spec.ndim, b_spec.ndim)
+        out_shape = jnp.broadcast_shapes(a_spec.shape, b_spec.shape)
+
+        # align dim shardings right-aligned (numpy broadcasting)
+        def aligned(sh, spec):
+            dims = [() for _ in range(out_ndim)]
+            off = out_ndim - spec.ndim
+            for i, d in enumerate(sh.dims):
+                dims[off + i] = tuple(d.axes)
+            return dims
+
+    # choose, per output dim, the sharding from whichever input is not
+    # broadcast on that dim; require the other to match (or be size-1).
+        a_dims = aligned(a_sh, a_spec)
+        b_dims = aligned(b_sh, b_spec)
+        out_dims: List[Tuple[str, ...]] = []
+        req_a = list(a_dims)
+        req_b = list(b_dims)
+        for i in range(out_ndim):
+            ai = i - (out_ndim - a_spec.ndim)
+            bi = i - (out_ndim - b_spec.ndim)
+            a_bcast = ai < 0 or a_spec.shape[ai] == 1 != out_shape[i]
+            b_bcast = bi < 0 or b_spec.shape[bi] == 1 != out_shape[i]
+            if a_bcast and not b_bcast:
+                out_dims.append(tuple(b_dims[i]))
+            elif b_bcast and not a_bcast:
+                out_dims.append(tuple(a_dims[i]))
+            else:
+                # both real: must agree; prefer a's, force b to match
+                out_dims.append(tuple(a_dims[i]))
+                req_b[i] = a_dims[i]
+
+        def rebuild(dims, spec, partial_axes):
+            off = out_ndim - spec.ndim
+            own = dims[off:]
+            sh = TensorSharding.replicated(spec.ndim)
+            for i, axes in enumerate(own):
+                # never shard a broadcast (size-1) dim
+                if axes and spec.shape[i] != 1:
+                    sh = sh.with_dim(i, tuple(axes))
+            return TensorSharding(sh.dims, partial_axes)
+
+        a_req = rebuild(req_a, a_spec, a_sh.partial_axes if partial else frozenset())
+        b_req = rebuild(req_b, b_spec, b_sh.partial_axes if partial else frozenset())
+        out_sh = TensorSharding.from_axes(
+            out_ndim, {i: d for i, d in enumerate(out_dims) if d}, partial
+        )
+        return ShardingSolution(inputs=[a_req, b_req], outputs=[out_sh])
+
+    def flops(self, in_specs):
+        return self.infer_shapes(list(in_specs))[0].size
+
+
+@register_op
+class Cast(Op):
+    type_name = "cast"
+
+    def __init__(self, dtype):
+        self.dtype = jnp.dtype(dtype).name
+
+    def infer_shapes(self, in_specs):
+        return [TensorSpec(in_specs[0].shape, jnp.dtype(self.dtype))]
+
+    def lower(self, ctx, inputs, params):
+        return [inputs[0].astype(self.dtype)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        sh = propagate(in_shardings[0] if in_shardings else None, in_specs[0])
+        return ShardingSolution(inputs=[sh], outputs=[sh])
+
+
+@register_op
+class Dropout(Op):
+    type_name = "dropout"
+
+    def __init__(self, rate: float, seed: int = 0):
+        self.rate = float(rate)
+        self.seed = int(seed)
+
+    def infer_shapes(self, in_specs):
+        return [in_specs[0]]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if not ctx.training or self.rate == 0.0:
+            return [x]
+        rng = ctx.rng
+        if rng is None:
+            rng = jax.random.PRNGKey(self.seed)
+        if ctx.mode == "local" and ctx.mesh is not None:
+            # distinct mask per device shard
+            lin = 0
+            for a in ctx.mesh.axis_names:
+                lin = lin * ctx.mesh.shape[a] + jax.lax.axis_index(a)
+            rng = jax.random.fold_in(rng, lin)
+        keep = jax.random.bernoulli(rng, 1.0 - self.rate, x.shape)
+        return [jnp.where(keep, x / (1.0 - self.rate), 0).astype(x.dtype)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        sh = propagate(in_shardings[0] if in_shardings else None, in_specs[0])
+        if sh.partial_axes:
+            sh = TensorSharding(sh.dims, frozenset())
+        return ShardingSolution(inputs=[sh], outputs=[sh])

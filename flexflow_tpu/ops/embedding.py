@@ -1,0 +1,125 @@
+"""Embedding lookup with aggregation modes and table sharding.
+
+Reference: ``src/ops/embedding.cc/.cu`` — aggr modes NONE/SUM/AVG; the DLRM
+config shards the table (BASELINE config #3).
+
+Parallel dims:
+
+* ``sample``      — shard the batch dim.
+* ``channel_out`` — shard the embedding feature dim (table column-sharded).
+* ``entry``       — shard the vocabulary rows across devices (DLRM-style table
+  sharding).  Each shard answers only ids in its row range and contributes 0
+  elsewhere, so the output is a partial sum — resolved by the normalizer with
+  Reduction/AllReduce, which XLA lowers to an ICI collective (the reference
+  uses a custom CUDA gather + NCCL).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import ParamSpec, TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+
+
+@register_op
+class Embedding(Op):
+    type_name = "embedding"
+
+    def __init__(
+        self,
+        num_entries: int,
+        out_dim: int,
+        aggr: str = "none",  # none | sum | avg
+        dtype=jnp.float32,
+        kernel_initializer=None,
+    ):
+        if aggr not in ("none", "sum", "avg"):
+            raise ValueError(f"bad aggr {aggr!r}")
+        self.num_entries = int(num_entries)
+        self.out_dim = int(out_dim)
+        self.aggr = aggr
+        self.dtype = jnp.dtype(dtype).name
+        self.kernel_initializer = kernel_initializer
+
+    def infer_shapes(self, in_specs):
+        ids = in_specs[0]
+        if self.aggr == "none":
+            shape = ids.shape + (self.out_dim,)
+        else:
+            shape = ids.shape[:-1] + (self.out_dim,)
+        return [TensorSpec(shape, jnp.dtype(self.dtype))]
+
+    def params(self):
+        return [
+            ParamSpec(
+                "weight",
+                TensorSpec((self.num_entries, self.out_dim), jnp.dtype(self.dtype)),
+                self.kernel_initializer,
+            )
+        ]
+
+    def lower(self, ctx, inputs, params):
+        ids = inputs[0]
+        weight = params["weight"]
+        entry_axes = tuple(ctx.config.get("entry", ())) if ctx.config else ()
+        if entry_axes and ctx.mode == "local" and ctx.mesh is not None:
+            # vocab-sharded lookup: answer only ids in this shard's row range
+            rows = weight.shape[0]
+            idx = jnp.int32(0)
+            for a in entry_axes:
+                idx = idx * ctx.mesh.shape[a] + jax.lax.axis_index(a)
+            lo = idx * rows
+            local_ids = jnp.clip(ids - lo, 0, rows - 1)
+            emb = jnp.take(weight, local_ids, axis=0)
+            in_range = ((ids >= lo) & (ids < lo + rows))[..., None]
+            emb = jnp.where(in_range, emb, jnp.zeros_like(emb))
+        else:
+            emb = jnp.take(weight, ids, axis=0)
+        if self.aggr == "sum":
+            emb = jnp.sum(emb, axis=-2)
+        elif self.aggr == "avg":
+            emb = jnp.mean(emb, axis=-2)
+        return [emb.astype(self.dtype)]
+
+    def parallel_dims(self, in_specs):
+        return {
+            "sample": in_specs[0].shape[0],
+            "channel_out": self.out_dim,
+            "entry": self.num_entries,
+        }
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        ids = in_specs[0]
+        sample = tuple(config.get("sample", ()))
+        c_out = tuple(config.get("channel_out", ()))
+        entry = tuple(config.get("entry", ()))
+
+        ids_sh = TensorSharding.replicated(ids.ndim)
+        if sample:
+            ids_sh = ids_sh.with_dim(0, sample)
+
+        w_sh = TensorSharding.replicated(2)
+        if entry:
+            w_sh = w_sh.with_dim(0, entry)
+        if c_out:
+            w_sh = w_sh.with_dim(1, c_out)
+
+        out = self.infer_shapes([ids])[0]
+        out_sh = TensorSharding.replicated(out.ndim)
+        if sample:
+            out_sh = out_sh.with_dim(0, sample)
+        if c_out:
+            out_sh = out_sh.with_dim(out.ndim - 1, c_out)
+        if entry:
+            out_sh = out_sh.with_partial(entry)
+        return ShardingSolution(
+            inputs=[ids_sh], outputs=[out_sh], params={"weight": w_sh}
+        )
+
+    def flops(self, in_specs):
+        return self.infer_shapes(list(in_specs))[0].size

@@ -1,0 +1,227 @@
+"""Linear (dense) and BatchMatmul.
+
+Reference: ``src/ops/linear.cc/.cu`` (cuBLAS GEMM + fused activation) and
+``src/ops/batch_matmul.cc/.cu``.  On TPU the GEMM maps straight onto the MXU
+via ``jnp.dot``; activation/bias fusion is free under XLA.
+
+Parallelization (the SOAP dims of the MLSys'19 paper):
+
+* ``sample``      — shard the batch dim (data parallel).
+* ``channel_out`` — shard the output-feature dim: column-parallel linear
+  (Megatron "f"); weight sharded on its out dim, output sharded on last dim.
+* ``channel_in``  — shard the contracted dim: row-parallel linear; weight
+  sharded on its in dim, input expected sharded on last dim, and the output is
+  a PARTIAL SUM over those axes — the state FlexFlow resolves with its
+  Reduction/AllReduce parallel ops, and which the PCG normalizer here resolves
+  identically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import ParamSpec, TensorSpec
+from ..core.op import Op, OpContext, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+from .elementwise import UNARY_FNS, propagate
+
+
+@register_op
+class Linear(Op):
+    type_name = "linear"
+
+    def __init__(
+        self,
+        out_dim: int,
+        activation: Optional[str] = None,
+        use_bias: bool = True,
+        in_dim: Optional[int] = None,
+        dtype=jnp.float32,
+        kernel_initializer=None,
+        bias_initializer=None,
+        quantization: Optional[str] = None,
+    ):
+        self.out_dim = int(out_dim)
+        self.in_dim = in_dim  # filled by infer_shapes on first use
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+        self.dtype = jnp.dtype(dtype).name
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+        self.quantization = quantization
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        if self.in_dim is None:
+            self.in_dim = x.shape[-1]
+        elif self.in_dim != x.shape[-1]:
+            raise ValueError(
+                f"linear expects in_dim {self.in_dim}, got {x.shape[-1]}"
+            )
+        return [TensorSpec(x.shape[:-1] + (self.out_dim,), jnp.dtype(self.dtype))]
+
+    def params(self) -> List[ParamSpec]:
+        ps = [
+            ParamSpec(
+                "kernel",
+                TensorSpec((self.in_dim, self.out_dim), jnp.dtype(self.dtype)),
+                self.kernel_initializer,
+            )
+        ]
+        if self.use_bias:
+            ps.append(
+                ParamSpec(
+                    "bias",
+                    TensorSpec((self.out_dim,), jnp.dtype(self.dtype)),
+                    self.bias_initializer,
+                )
+            )
+        return ps
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        kernel = params["kernel"]
+        y = jnp.dot(x, kernel, preferred_element_type=_acc_dtype(x.dtype))
+        partial_in = bool(ctx.config and ctx.config.get("channel_in"))
+        if self.use_bias:
+            bias = params["bias"]
+            if partial_in and ctx.mode == "local" and ctx.mesh is not None:
+                # output is a partial sum over channel_in axes: add the bias on
+                # exactly one shard so the later reduction counts it once
+                idx = jnp.int32(0)
+                for a in ctx.config["channel_in"]:
+                    idx = idx + jax.lax.axis_index(a)
+                bias = jnp.where(idx == 0, bias, jnp.zeros_like(bias))
+            y = y + bias
+        if self.activation is not None and not partial_in:
+            y = UNARY_FNS[self.activation](y)
+        return [y.astype(self.dtype)]
+
+    def parallel_dims(self, in_specs):
+        return {
+            "sample": in_specs[0].shape[0],
+            "channel_out": self.out_dim,
+            "channel_in": in_specs[0].shape[-1],
+        }
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sample = tuple(config.get("sample", ()))
+        c_out = tuple(config.get("channel_out", ()))
+        c_in = tuple(config.get("channel_in", ()))
+        if c_in and self.activation is not None:
+            raise ValueError(
+                "channel_in (row-parallel) sharding is incompatible with a "
+                "fused activation: the output is a partial sum"
+            )
+
+        x_sh = TensorSharding.replicated(x.ndim)
+        if sample:
+            x_sh = x_sh.with_dim(0, sample)
+        if c_in:
+            x_sh = x_sh.with_dim(x.ndim - 1, c_in)
+
+        kernel_sh = TensorSharding.replicated(2)
+        if c_in:
+            kernel_sh = kernel_sh.with_dim(0, c_in)
+        if c_out:
+            kernel_sh = kernel_sh.with_dim(1, c_out)
+
+        out_sh = TensorSharding.replicated(x.ndim)
+        if sample:
+            out_sh = out_sh.with_dim(0, sample)
+        if c_out:
+            out_sh = out_sh.with_dim(x.ndim - 1, c_out)
+        if c_in:
+            out_sh = out_sh.with_partial(c_in)
+
+        params = {"kernel": kernel_sh}
+        if self.use_bias:
+            bias_sh = TensorSharding.replicated(1)
+            if c_out:
+                bias_sh = bias_sh.with_dim(0, c_out)
+            params["bias"] = bias_sh
+        return ShardingSolution(inputs=[x_sh], outputs=[out_sh], params=params)
+
+    def flops(self, in_specs):
+        x = in_specs[0]
+        batch = int(np.prod(x.shape[:-1]))
+        return 2 * batch * x.shape[-1] * self.out_dim
+
+
+@register_op
+class BatchMatmul(Op):
+    """Batched matmul: (..., m, k) x (..., k, n) -> (..., m, n).
+
+    Reference: ``src/ops/batch_matmul.cc`` (cuBLAS strided-batched GEMM).
+    """
+
+    type_name = "batch_matmul"
+
+    def __init__(self, a_transposed: bool = False, b_transposed: bool = False):
+        self.a_transposed = a_transposed
+        self.b_transposed = b_transposed
+
+    def _dims(self, a: TensorSpec, b: TensorSpec):
+        am, ak = (a.shape[-1], a.shape[-2]) if self.a_transposed else a.shape[-2:]
+        bk, bn = (b.shape[-1], b.shape[-2]) if self.b_transposed else b.shape[-2:]
+        if ak != bk:
+            raise ValueError(f"batch_matmul contraction mismatch: {a} x {b}")
+        return am, ak, bn
+
+    def infer_shapes(self, in_specs):
+        a, b = in_specs
+        m, k, n = self._dims(a, b)
+        batch = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+        return [TensorSpec(tuple(batch) + (m, n), a.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        a, b = inputs
+        if self.a_transposed:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.b_transposed:
+            b = jnp.swapaxes(b, -1, -2)
+        return [
+            jnp.matmul(a, b, preferred_element_type=_acc_dtype(a.dtype)).astype(
+                a.dtype
+            )
+        ]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        # batch dims are the parallel dims; propagate producer sharding on
+        # them, require contraction + row/col dims unsharded
+        a, b = in_specs
+        sample = tuple(config.get("sample", ()))
+        a_sh = propagate(in_shardings[0] if in_shardings else None, a)
+        b_sh = propagate(in_shardings[1] if in_shardings else None, b)
+        a_sh = TensorSharding(
+            tuple(a_sh.dims[:-2]) + (a_sh.dims[-2].__class__(),) * 2, frozenset()
+        )
+        b_sh = TensorSharding(
+            tuple(b_sh.dims[:-2]) + (b_sh.dims[-2].__class__(),) * 2, frozenset()
+        )
+        if sample:
+            a_sh = a_sh.with_dim(0, sample)
+            b_sh = b_sh.with_dim(0, sample)
+        out = self.infer_shapes([a, b])[0]
+        out_sh = TensorSharding.replicated(out.ndim)
+        for i in range(out.ndim - 2):
+            if i < len(a_sh.dims) and a_sh.dims[i].axes:
+                out_sh = out_sh.with_dim(i, a_sh.dims[i].axes)
+        return ShardingSolution(inputs=[a_sh, b_sh], outputs=[out_sh])
+
+    def flops(self, in_specs):
+        a, b = in_specs
+        m, k, n = self._dims(a, b)
+        batch = int(np.prod(jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])) or 1)
+        return 2 * batch * m * k * n
+
+
+def _acc_dtype(dtype):
+    if jnp.dtype(dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return jnp.float32
+    return dtype

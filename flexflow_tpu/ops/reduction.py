@@ -1,0 +1,264 @@
+"""Softmax, reductions, and the decode heads (ArgMax/TopK/Sampling/BeamTopK).
+
+Reference: ``src/ops/{softmax,reduce,argmax,arg_topk,topk,sampling,
+beam_topk}.cc/.cu`` — ArgMax/ArgTopK/Sampling/BeamTopK are the serve decode
+heads run every step on the logits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+from .elementwise import propagate
+
+
+def _reduce_last_local(spec: TensorSpec, in_sh) -> TensorSharding:
+    sh = propagate(in_sh, spec)
+    sh = TensorSharding(sh.dims, frozenset())
+    return sh.with_dim(spec.ndim - 1, ())
+
+
+@register_op
+class Softmax(Op):
+    type_name = "softmax"
+
+    def __init__(self, axis: int = -1):
+        self.axis = int(axis)
+
+    def infer_shapes(self, in_specs):
+        return [in_specs[0]]
+
+    def lower(self, ctx, inputs, params):
+        return [jax.nn.softmax(inputs[0], axis=self.axis)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset()).with_dim(self.axis % x.ndim, ())
+        return ShardingSolution(inputs=[sh], outputs=[sh])
+
+    def flops(self, in_specs):
+        return 5 * in_specs[0].size
+
+
+@register_op
+class Reduce(Op):
+    """sum/mean/max over axes (keepdims optional).
+
+    Reference: ``src/ops/reduce.cc``.
+    """
+
+    type_name = "reduce"
+
+    FNS = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}
+
+    def __init__(self, fn: str, axes: Sequence[int], keepdims: bool = False):
+        self.fn = fn
+        self.axes = tuple(sorted(int(a) for a in axes))
+        self.keepdims = bool(keepdims)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        shape = []
+        for i, s in enumerate(x.shape):
+            if i in self.axes:
+                if self.keepdims:
+                    shape.append(1)
+            else:
+                shape.append(s)
+        return [TensorSpec(tuple(shape), x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        return [
+            self.FNS[self.fn](inputs[0], axis=self.axes, keepdims=self.keepdims)
+        ]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset())
+        for a in self.axes:
+            sh = sh.with_dim(a % x.ndim, ())  # reduced dims must be local
+        out = self.infer_shapes([x])[0]
+        out_dims = []
+        for i in range(x.ndim):
+            if i in self.axes:
+                if self.keepdims:
+                    out_dims.append(())
+            else:
+                out_dims.append(tuple(sh.dims[i].axes))
+        out_sh = TensorSharding.from_axes(
+            out.ndim, {i: d for i, d in enumerate(out_dims) if d}
+        )
+        return ShardingSolution(inputs=[sh], outputs=[out_sh])
+
+
+@register_op
+class ArgMax(Op):
+    """Greedy decode head: argmax over vocab (last dim).
+
+    Reference: ``src/ops/argmax.cc/.cu`` (optionally also returns parent ids
+    for beam verify; here plain argmax — tree logic lives in serve/).
+    """
+
+    type_name = "argmax"
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        return [TensorSpec(x.shape[:-1], jnp.int32)]
+
+    def lower(self, ctx, inputs, params):
+        return [jnp.argmax(inputs[0], axis=-1).astype(jnp.int32)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = _reduce_last_local(x, in_shardings[0] if in_shardings else None)
+        out_sh = TensorSharding(sh.dims[:-1], frozenset())
+        return ShardingSolution(inputs=[sh], outputs=[out_sh])
+
+
+@register_op
+class TopK(Op):
+    """Top-k values + indices over last dim. Reference: ``src/ops/topk.cc``."""
+
+    type_name = "topk"
+
+    def __init__(self, k: int, sorted: bool = True):
+        self.k = int(k)
+        self.sorted = bool(sorted)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        shape = x.shape[:-1] + (self.k,)
+        return [TensorSpec(shape, x.dtype), TensorSpec(shape, jnp.int32)]
+
+    def lower(self, ctx, inputs, params):
+        v, i = jax.lax.top_k(inputs[0], self.k)
+        return [v, i.astype(jnp.int32)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = _reduce_last_local(x, in_shardings[0] if in_shardings else None)
+        out_sh = TensorSharding(sh.dims, frozenset())
+        return ShardingSolution(inputs=[sh], outputs=[out_sh, out_sh])
+
+
+@register_op
+class ArgTopK(Op):
+    """Top-k indices only (+ optional probs). Reference: ``src/ops/arg_topk.cc``."""
+
+    type_name = "arg_topk"
+
+    def __init__(self, k: int, speculative_decoding: bool = False):
+        self.k = int(k)
+        self.speculative_decoding = bool(speculative_decoding)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        shape = x.shape[:-1] + (self.k,)
+        out = [TensorSpec(shape, jnp.int32)]
+        if self.speculative_decoding:
+            out.append(TensorSpec(shape, x.dtype))
+        return out
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        v, i = jax.lax.top_k(x, self.k)
+        outs = [i.astype(jnp.int32)]
+        if self.speculative_decoding:
+            probs = jax.nn.softmax(x, axis=-1)
+            outs.append(jnp.take_along_axis(probs, i, axis=-1))
+        return outs
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = _reduce_last_local(x, in_shardings[0] if in_shardings else None)
+        out_sh = TensorSharding(sh.dims, frozenset())
+        outs = [out_sh] * (2 if self.speculative_decoding else 1)
+        return ShardingSolution(inputs=[sh], outputs=list(outs))
+
+
+@register_op
+class Sampling(Op):
+    """Nucleus (top-p) sampling head. Reference: ``src/ops/sampling.cc/.cu``."""
+
+    type_name = "sampling"
+
+    def __init__(self, top_p: float = 1.0, temperature: float = 1.0, seed: int = 0):
+        self.top_p = float(top_p)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        return [TensorSpec(x.shape[:-1], jnp.int32)]
+
+    def lower(self, ctx, inputs, params):
+        logits = inputs[0]
+        if self.temperature != 1.0:
+            logits = logits / self.temperature
+        rng = ctx.rng if ctx.rng is not None else jax.random.PRNGKey(self.seed)
+        if self.top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep tokens until cumulative prob exceeds top_p
+            cutoff_idx = jnp.sum(cum < self.top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        tok = jax.random.categorical(rng, logits, axis=-1)
+        return [tok.astype(jnp.int32)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = _reduce_last_local(x, in_shardings[0] if in_shardings else None)
+        out_sh = TensorSharding(sh.dims[:-1], frozenset())
+        return ShardingSolution(inputs=[sh], outputs=[out_sh])
+
+
+@register_op
+class BeamTopK(Op):
+    """Per-request beam expansion head used by SpecInfer's SSM phase: top-k
+    over (beam * vocab) giving token ids, parent beam ids and probs.
+
+    Reference: ``src/ops/beam_topk.cc/.cu``.
+    """
+
+    type_name = "beam_topk"
+
+    def __init__(self, max_beam_width: int):
+        self.k = int(max_beam_width)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]  # (num_slots, beam, vocab) flattened scores
+        shape = x.shape[:-2] + (self.k,)
+        return [
+            TensorSpec(shape, jnp.int32),   # token ids
+            TensorSpec(shape, jnp.int32),   # parent beam index
+            TensorSpec(shape, x.dtype),     # log-probs
+        ]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]  # (..., beam, vocab) joint log-probs
+        beam, vocab = x.shape[-2], x.shape[-1]
+        flat = x.reshape(x.shape[:-2] + (beam * vocab,))
+        v, i = jax.lax.top_k(flat, self.k)
+        return [
+            (i % vocab).astype(jnp.int32),
+            (i // vocab).astype(jnp.int32),
+            v,
+        ]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset())
+        sh = sh.with_dim(x.ndim - 1, ()).with_dim(x.ndim - 2, ())
+        out_sh = TensorSharding(sh.dims[:-2] + sh.dims[-1:], frozenset())
+        return ShardingSolution(inputs=[sh], outputs=[out_sh, out_sh, out_sh])

@@ -1,0 +1,240 @@
+"""Shape-manipulation ops: Reshape, Transpose, Split, Concat, Gather, Reverse,
+Flat, Squeeze/Unsqueeze.
+
+Reference: ``src/ops/{reshape,transpose,split,concat,gather,reverse,flat}.cc``.
+All are data-movement only; XLA folds most of them into layout changes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+from .elementwise import propagate
+
+
+@register_op
+class Reshape(Op):
+    type_name = "reshape"
+
+    def __init__(self, shape: Sequence[int]):
+        self.shape = tuple(int(s) for s in shape)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        shape = list(self.shape)
+        if -1 in shape:
+            i = shape.index(-1)
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape[i] = x.size // known
+        if int(np.prod(shape)) != x.size:
+            raise ValueError(f"reshape {x.shape} -> {shape}: size mismatch")
+        return [TensorSpec(tuple(shape), x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if ctx.mode == "local" and ctx.mesh is not None:
+            # local shards: scale any sharded-and-preserved leading dim
+            out_sh = ctx.extras["out_sharding"]
+            shape = list(self.infer_shapes(ctx.extras["in_specs"])[0].shape)
+            for i, d in enumerate(out_sh.dims):
+                deg = 1
+                for a in d.axes:
+                    deg *= ctx.mesh.shape[a]
+                shape[i] //= deg
+            return [jnp.reshape(x, shape)]
+        return [jnp.reshape(x, self.infer_shapes(ctx.extras["in_specs"])[0].shape)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        out = self.infer_shapes([x])[0]
+        in_sh = propagate(in_shardings[0] if in_shardings else None, x)
+        in_sh = TensorSharding(in_sh.dims, frozenset())
+        # keep dim-0 sharding iff dim 0 extent is preserved; all else local
+        keep0 = (
+            x.ndim >= 1
+            and out.ndim >= 1
+            and x.shape[0] == out.shape[0]
+            and in_sh.dims[0].axes
+        )
+        req = TensorSharding.replicated(x.ndim)
+        out_sh = TensorSharding.replicated(out.ndim)
+        if keep0:
+            req = req.with_dim(0, in_sh.dims[0].axes)
+            out_sh = out_sh.with_dim(0, in_sh.dims[0].axes)
+        return ShardingSolution(inputs=[req], outputs=[out_sh])
+
+
+@register_op
+class Transpose(Op):
+    type_name = "transpose"
+
+    def __init__(self, perm: Sequence[int]):
+        self.perm = tuple(int(p) for p in perm)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        return [TensorSpec(tuple(x.shape[p] for p in self.perm), x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        return [jnp.transpose(inputs[0], self.perm)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset())
+        out_sh = TensorSharding(tuple(sh.dims[p] for p in self.perm), frozenset())
+        return ShardingSolution(inputs=[sh], outputs=[out_sh])
+
+
+@register_op
+class Concat(Op):
+    type_name = "concat"
+
+    def __init__(self, axis: int):
+        self.axis = int(axis)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        ax = self.axis % x.ndim
+        total = sum(s.shape[ax] for s in in_specs)
+        shape = list(x.shape)
+        shape[ax] = total
+        return [TensorSpec(tuple(shape), x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        return [jnp.concatenate(inputs, axis=self.axis)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        ax = self.axis % x.ndim
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset()).with_dim(ax, ())
+        return ShardingSolution(
+            inputs=[sh] * len(in_specs), outputs=[sh]
+        )
+
+
+@register_op
+class Split(Op):
+    type_name = "split"
+
+    def __init__(self, sizes: Sequence[int], axis: int):
+        self.sizes = tuple(int(s) for s in sizes)
+        self.axis = int(axis)
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        ax = self.axis % x.ndim
+        if sum(self.sizes) != x.shape[ax]:
+            raise ValueError(f"split sizes {self.sizes} != dim {x.shape[ax]}")
+        out = []
+        for s in self.sizes:
+            shape = list(x.shape)
+            shape[ax] = s
+            out.append(TensorSpec(tuple(shape), x.dtype))
+        return out
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        ax = self.axis % x.ndim
+        outs = []
+        off = 0
+        for s in self.sizes:
+            outs.append(jax.lax.slice_in_dim(x, off, off + s, axis=ax))
+            off += s
+        return outs
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        ax = self.axis % x.ndim
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset()).with_dim(ax, ())
+        return ShardingSolution(inputs=[sh], outputs=[sh] * len(self.sizes))
+
+
+@register_op
+class Gather(Op):
+    """Gather along an axis with an index tensor (torch.gather semantics).
+
+    Reference: ``src/ops/gather.cc``.
+    """
+
+    type_name = "gather"
+
+    def __init__(self, axis: int):
+        self.axis = int(axis)
+
+    def infer_shapes(self, in_specs):
+        x, idx = in_specs
+        return [TensorSpec(idx.shape, x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        x, idx = inputs
+        return [jnp.take_along_axis(x, idx, axis=self.axis)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x, idx = in_specs
+        sh_x = TensorSharding.replicated(x.ndim)
+        sh_i = TensorSharding.replicated(idx.ndim)
+        sample = tuple(config.get("sample", ()))
+        ax = self.axis % x.ndim
+        if sample and ax != 0:
+            sh_x = sh_x.with_dim(0, sample)
+            sh_i = sh_i.with_dim(0, sample)
+        return ShardingSolution(inputs=[sh_x, sh_i], outputs=[sh_i])
+
+
+@register_op
+class Reverse(Op):
+    type_name = "reverse"
+
+    def __init__(self, axis: int):
+        self.axis = int(axis)
+
+    def infer_shapes(self, in_specs):
+        return [in_specs[0]]
+
+    def lower(self, ctx, inputs, params):
+        return [jnp.flip(inputs[0], axis=self.axis)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        sh = propagate(in_shardings[0] if in_shardings else None, x)
+        sh = TensorSharding(sh.dims, frozenset()).with_dim(self.axis % x.ndim, ())
+        return ShardingSolution(inputs=[sh], outputs=[sh])
+
+
+@register_op
+class Flat(Op):
+    """Flatten all dims after the batch dim (NCHW -> N,CHW).
+
+    Reference: ``src/ops/flat.cc``.
+    """
+
+    type_name = "flat"
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        return [TensorSpec((x.shape[0], int(np.prod(x.shape[1:]))), x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        return [jnp.reshape(x, (x.shape[0], -1))]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x = in_specs[0]
+        in_sh = propagate(in_shardings[0] if in_shardings else None, x)
+        axes0 = in_sh.dims[0].axes if in_sh.dims else ()
+        req = TensorSharding.replicated(x.ndim)
+        out_sh = TensorSharding.replicated(2)
+        if axes0:
+            req = req.with_dim(0, axes0)
+            out_sh = out_sh.with_dim(0, axes0)
+        return ShardingSolution(inputs=[req], outputs=[out_sh])

@@ -1,0 +1,63 @@
+"""Mesh construction: the TPU replacement for FlexFlow's MachineView/FFMapper.
+
+Reference: ``src/mapper/mapper.cc`` (task->GPU placement) and
+``include/flexflow/machine_view.h``.  On TPU "the mapper becomes data": a
+``jax.sharding.Mesh`` with named axes fixes device placement, and per-op
+parallel configs (axis-name assignments) replace per-op MachineViews.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Create a named mesh.
+
+    ``shape=None``: one axis ``"dp"`` spanning all devices.
+    ``shape={"dp": 4, "tp": 2}``: row-major assignment over devices; sizes
+    must multiply to the device count used.
+    """
+
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = {"dp": len(devices)}
+    sizes = list(shape.values())
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devices)}")
+    devices = devices[:n]
+    arr = np.array(devices, dtype=object).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh({"dp": 1}, jax.devices()[:1])
+
+
+def mesh_axes(mesh: Mesh) -> List[str]:
+    return list(mesh.axis_names)
+
+
+def data_parallel_strategy(graph, mesh: Mesh, axes: Sequence[str] = ("dp",)):
+    """The ``--only-data-parallel`` strategy: shard 'sample' over ``axes`` on
+    every op that exposes it (reference: FFModel's data-parallel fallback)."""
+    axes = tuple(a for a in axes if a in mesh.shape and mesh.shape[a] > 1)
+    configs = {}
+    if not axes:
+        return configs
+    for node in graph.nodes:
+        in_specs = [graph.spec(t) for t in node.inputs]
+        pdims = node.op.parallel_dims(in_specs)
+        if "sample" in pdims and pdims["sample"] % int(
+            np.prod([mesh.shape[a] for a in axes])
+        ) == 0:
+            configs[node.name] = {"sample": axes}
+    return configs

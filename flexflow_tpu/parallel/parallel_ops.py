@@ -1,0 +1,353 @@
+"""The five FlexFlow parallel ops (+ AllToAll), TPU-native.
+
+Reference: ``src/parallel_ops/{allreduce,repartition,combine,reduction,
+replicate}.cc/.cu`` — NCCL-backed PCG nodes.  Here each parallel op is still a
+first-class PCG node (so the Unity-style search can see and cost it), but it
+lowers to:
+
+* **spmd mode** (GSPMD path): ``jax.lax.with_sharding_constraint`` — XLA's
+  SPMD partitioner emits the matching ICI collective (all-gather,
+  reduce-scatter, all-reduce, all-to-all, collective-permute).
+* **local mode** (shard_map path): the explicit ``jax.lax`` collective.
+
+No NCCL, no communicator setup: the mesh + axis names replace
+``MachineView``-keyed communicators.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.graph import TensorSpec
+from ..core.op import Op, OpContext, register_op
+from ..core.sharding import TensorSharding
+
+
+def _axes_degree(axes: Tuple[str, ...], mesh) -> int:
+    d = 1
+    shape = dict(mesh.shape)
+    for a in axes:
+        d *= shape[a]
+    return d
+
+
+def _constrain(ctx: OpContext, x: jax.Array, sharding: TensorSharding) -> jax.Array:
+    if ctx.mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, sharding.named_sharding(ctx.mesh))
+
+
+class ParallelOp(Op):
+    """Base: identity on global shape; transforms the sharding annotation."""
+
+    def infer_shapes(self, in_specs: List[TensorSpec]) -> List[TensorSpec]:
+        return [in_specs[0]]
+
+    def is_parallel_op(self) -> bool:
+        return True
+
+    def flops(self, in_specs) -> int:
+        return 0
+
+    # sharding in -> sharding out (annotation transform, validated)
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        raise NotImplementedError
+
+    def comm_bytes(self, spec: TensorSpec, sh_in: TensorSharding, mesh) -> int:
+        """Bytes moved per device (cost-model hook)."""
+        raise NotImplementedError
+
+
+@register_op
+class Replicate(ParallelOp):
+    """Annotation-only: assert the value is replicated over ``axes``.
+
+    Reference ``src/parallel_ops/replicate.cc`` broadcasts one copy to many
+    devices; under shard_map/GSPMD a tensor whose spec doesn't mention an axis
+    already lives replicated on every device of that axis, so this is free.
+    """
+
+    type_name = "replicate"
+
+    def __init__(self, axes: Tuple[str, ...]):
+        self.axes = tuple(axes)
+
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        used = sh.used_axes()
+        for a in self.axes:
+            if a in used:
+                raise ValueError(f"replicate: axis {a} already used by {sh}")
+        return sh
+
+    def lower(self, ctx, inputs, params):
+        return [inputs[0]]
+
+    def comm_bytes(self, spec, sh_in, mesh) -> int:
+        return 0
+
+
+@register_op
+class Repartition(ParallelOp):
+    """Split logical dim ``dim`` across ``axes`` (from replicated).
+
+    Reference ``src/parallel_ops/partition.cc``.
+    """
+
+    type_name = "repartition"
+
+    def __init__(self, dim: int, axes: Tuple[str, ...]):
+        self.dim = dim
+        self.axes = tuple(axes)
+
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        if sh.dims[self.dim].axes:
+            raise ValueError(f"repartition: dim {self.dim} already sharded: {sh}")
+        for a in self.axes:
+            if a in sh.used_axes():
+                raise ValueError(f"repartition: axis {a} already used by {sh}")
+        return sh.with_dim(self.dim, self.axes)
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if ctx.mode == "local":
+            deg = _axes_degree(self.axes, ctx.mesh)
+            if deg == 1:
+                return [x]
+            # linearized index over the (possibly multiple) mesh axes
+            idx = 0
+            for a in self.axes:
+                idx = idx * ctx.mesh.shape[a] + lax.axis_index(a)
+            size = x.shape[self.dim] // deg
+            return [lax.dynamic_slice_in_dim(x, idx * size, size, axis=self.dim)]
+        out_sh = ctx.extras["out_sharding"]
+        return [_constrain(ctx, x, out_sh)]
+
+    def comm_bytes(self, spec, sh_in, mesh) -> int:
+        return 0  # local slicing of an already-replicated value
+
+
+@register_op
+class Combine(ParallelOp):
+    """All-gather logical dim ``dim`` from ``axes`` back to replicated.
+
+    Reference ``src/parallel_ops/combine.cc``.
+    """
+
+    type_name = "combine"
+
+    def __init__(self, dim: int, axes: Tuple[str, ...]):
+        self.dim = dim
+        self.axes = tuple(axes)
+
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        have = sh.dims[self.dim].axes
+        if tuple(have) != tuple(self.axes):
+            raise ValueError(
+                f"combine: dim {self.dim} sharded over {have}, expected {self.axes}"
+            )
+        return sh.with_dim(self.dim, ())
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if ctx.mode == "local":
+            for a in reversed(self.axes):
+                x = lax.all_gather(x, a, axis=self.dim, tiled=True)
+            return [x]
+        out_sh = ctx.extras["out_sharding"]
+        return [_constrain(ctx, x, out_sh)]
+
+    def comm_bytes(self, spec, sh_in, mesh) -> int:
+        deg = _axes_degree(self.axes, mesh)
+        return int(spec.nbytes() * (deg - 1) / max(deg, 1))
+
+
+@register_op
+class Reduction(ParallelOp):
+    """Reduce-scatter a partial-sum tensor: sum over ``axes``, shard ``dim``.
+
+    Reference ``src/parallel_ops/reduction.cc``.
+    """
+
+    type_name = "reduction"
+
+    def __init__(self, dim: int, axes: Tuple[str, ...]):
+        self.dim = dim
+        self.axes = tuple(axes)
+
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        if not set(self.axes) <= sh.partial_axes:
+            raise ValueError(
+                f"reduction over {self.axes}: input not partial over them ({sh})"
+            )
+        if sh.dims[self.dim].axes:
+            raise ValueError(f"reduction: dim {self.dim} already sharded")
+        return sh.without_partial(self.axes).with_dim(self.dim, self.axes)
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if ctx.mode == "local":
+            for a in reversed(self.axes):
+                x = lax.psum_scatter(x, a, scatter_dimension=self.dim, tiled=True)
+            return [x]
+        out_sh = ctx.extras["out_sharding"]
+        return [_constrain(ctx, x, out_sh)]
+
+    def comm_bytes(self, spec, sh_in, mesh) -> int:
+        deg = _axes_degree(self.axes, mesh)
+        return int(spec.nbytes() * (deg - 1) / max(deg, 1))
+
+
+@register_op
+class AllReduce(ParallelOp):
+    """Sum partial values over ``axes``; result replicated over them.
+
+    Reference ``src/parallel_ops/allreduce.cc`` (ncclAllReduce).
+    """
+
+    type_name = "allreduce"
+
+    def __init__(self, axes: Tuple[str, ...]):
+        self.axes = tuple(axes)
+
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        if not set(self.axes) <= sh.partial_axes:
+            raise ValueError(
+                f"allreduce over {self.axes}: input not partial over them ({sh})"
+            )
+        return sh.without_partial(self.axes)
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if ctx.mode == "local":
+            return [lax.psum(x, self.axes)]
+        out_sh = ctx.extras["out_sharding"]
+        return [_constrain(ctx, x, out_sh)]
+
+    def comm_bytes(self, spec, sh_in, mesh) -> int:
+        deg = _axes_degree(self.axes, mesh)
+        return int(2 * spec.nbytes() * (deg - 1) / max(deg, 1))
+
+
+@register_op
+class AllToAll(ParallelOp):
+    """Reshard: move sharding of ``axes`` from dim ``src_dim`` to ``dst_dim``.
+
+    No single FlexFlow parallel op maps to this; the reference expresses it as
+    Combine∘Repartition.  On TPU a fused all-to-all is strictly better (DLRM
+    embedding exchange, Ulysses-style sequence parallelism), so it is a
+    first-class node.
+    """
+
+    type_name = "all_to_all"
+
+    def __init__(self, src_dim: int, dst_dim: int, axes: Tuple[str, ...]):
+        self.src_dim = src_dim
+        self.dst_dim = dst_dim
+        self.axes = tuple(axes)
+
+    def transform_sharding(self, sh: TensorSharding, mesh) -> TensorSharding:
+        if tuple(sh.dims[self.src_dim].axes) != tuple(self.axes):
+            raise ValueError(
+                f"all_to_all: src dim {self.src_dim} not sharded over {self.axes}"
+            )
+        if sh.dims[self.dst_dim].axes:
+            raise ValueError(f"all_to_all: dst dim {self.dst_dim} already sharded")
+        return sh.with_dim(self.src_dim, ()).with_dim(self.dst_dim, self.axes)
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        if ctx.mode == "local":
+            for a in reversed(self.axes):
+                x = lax.all_to_all(
+                    x, a, split_axis=self.dst_dim, concat_axis=self.src_dim,
+                    tiled=True,
+                )
+            return [x]
+        out_sh = ctx.extras["out_sharding"]
+        return [_constrain(ctx, x, out_sh)]
+
+    def comm_bytes(self, spec, sh_in, mesh) -> int:
+        deg = _axes_degree(self.axes, mesh)
+        local_bytes = spec.nbytes() // max(deg, 1)
+        return int(local_bytes * (deg - 1) / max(deg, 1))
+
+
+def reshard_path(
+    src: TensorSharding, dst: TensorSharding, mesh
+) -> List[ParallelOp]:
+    """Compute a sequence of parallel ops converting sharding ``src`` -> ``dst``.
+
+    This is the PCG normalizer's core: the analogue of Unity inserting
+    Repartition/Combine/Replicate/Reduction nodes during graph rewriting.
+    Strategy: (1) clear partial sums (AllReduce, or Reduction straight into a
+    wanted shard), (2) per-dim fix-ups using AllToAll when sharding moves
+    between dims, else Combine then Repartition.
+    """
+
+    if src.ndim != dst.ndim:
+        raise ValueError("reshard between different ranks")
+    ops: List[ParallelOp] = []
+    cur = src
+
+    # 1) pending partial sums
+    if cur.partial_axes:
+        extra = cur.partial_axes - dst.partial_axes
+        if extra:
+            # try to fuse into a Reduction if dst wants exactly these axes on a dim
+            fused = False
+            for d in range(cur.ndim):
+                want = tuple(dst.dims[d].axes)
+                if want and set(want) == set(extra) and not cur.dims[d].axes:
+                    ops.append(Reduction(d, want))
+                    cur = ops[-1].transform_sharding(cur, mesh)
+                    fused = True
+                    break
+            if not fused:
+                ops.append(AllReduce(tuple(sorted(extra))))
+                cur = ops[-1].transform_sharding(cur, mesh)
+        if dst.partial_axes - src.partial_axes:
+            raise ValueError(f"cannot introduce partialness: {src} -> {dst}")
+
+    # 2) move/clear dim shardings
+    for d in range(cur.ndim):
+        have, want = tuple(cur.dims[d].axes), tuple(dst.dims[d].axes)
+        if have == want:
+            continue
+        if have and want and have != want:
+            ops.append(Combine(d, have))
+            cur = ops[-1].transform_sharding(cur, mesh)
+            have = ()
+        if have and not want:
+            # does another dim want exactly these axes? -> all_to_all
+            moved = False
+            for d2 in range(cur.ndim):
+                if d2 == d:
+                    continue
+                w2 = tuple(dst.dims[d2].axes)
+                if w2 == have and not cur.dims[d2].axes:
+                    ops.append(AllToAll(d, d2, have))
+                    cur = ops[-1].transform_sharding(cur, mesh)
+                    moved = True
+                    break
+            if not moved:
+                ops.append(Combine(d, have))
+                cur = ops[-1].transform_sharding(cur, mesh)
+
+    # 3) introduce wanted shardings still missing
+    for d in range(cur.ndim):
+        have, want = tuple(cur.dims[d].axes), tuple(dst.dims[d].axes)
+        if have != want:
+            if have:
+                ops.append(Combine(d, have))
+                cur = ops[-1].transform_sharding(cur, mesh)
+            if want:
+                ops.append(Repartition(d, want))
+                cur = ops[-1].transform_sharding(cur, mesh)
+
+    if (tuple(cur.dims) != tuple(dst.dims)) or (cur.partial_axes != dst.partial_axes):
+        raise AssertionError(f"reshard_path failed: got {cur}, want {dst}")
+    return ops

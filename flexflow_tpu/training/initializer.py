@@ -1,0 +1,68 @@
+"""Weight initializers.
+
+Reference: ``src/runtime/initializer.cc`` + ``initializer_kernel.cu``
+(GlorotUniform/Zero/Uniform/Norm as GPU tasks).  Here: pure functions
+``init(key, shape, dtype) -> array`` that run wherever XLA puts them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class GlorotUniform(Initializer):
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype):
+        fan_in = shape[0] if len(shape) >= 1 else 1
+        fan_out = shape[-1] if len(shape) >= 2 else shape[0]
+        if len(shape) == 4:  # conv OIHW: receptive field scales fans
+            rf = shape[2] * shape[3]
+            fan_in, fan_out = shape[1] * rf, shape[0] * rf
+        limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+class OneInitializer(Initializer):
+    def __call__(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, minv: float = -0.1, maxv: float = 0.1, seed: int = 0):
+        self.minv, self.maxv, self.seed = minv, maxv, seed
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.minv, self.maxv)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, seed: int = 0):
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+
+    def __call__(self, key, shape, dtype):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+def default_initializer_for(op, param_spec):
+    """Matches the reference defaults: Glorot for kernels, zeros for biases,
+    ones for norm gains."""
+    name = param_spec.name
+    if name in ("bias", "beta", "attn_bias", "running_mean"):
+        return ZeroInitializer()
+    if name in ("gamma", "running_var"):
+        return OneInitializer()
+    return GlorotUniform()

@@ -1,0 +1,40 @@
+"""Loss functions applied to the final op's output at compile time.
+
+Reference: ``src/loss_functions/loss_functions.cc/.cu`` — FlexFlow attaches a
+LossType at ``FFModel::compile`` and runs a CUDA backward kernel on the final
+logits; here the loss is a jnp expression and XLA autodiff provides backward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+MEAN_SQUARED_ERROR = "mean_squared_error"
+BINARY_CROSSENTROPY = "binary_crossentropy"
+IDENTITY = "identity"
+
+
+def compute_loss(loss_type: str, logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean loss over the batch. ``logits`` is the final op output; for the
+    crossentropy losses the final op is conventionally Softmax (matching the
+    reference's softmax+CE pairing), so probabilities arrive here."""
+    if loss_type == SPARSE_CATEGORICAL_CROSSENTROPY:
+        # logits are post-softmax probabilities (reference pipeline shape)
+        probs = jnp.clip(logits, 1e-10, 1.0)
+        labels = labels.reshape(labels.shape[0], -1)[..., 0].astype(jnp.int32)
+        ll = jnp.take_along_axis(jnp.log(probs), labels[:, None], axis=-1)
+        return -jnp.mean(ll)
+    if loss_type == CATEGORICAL_CROSSENTROPY:
+        probs = jnp.clip(logits, 1e-10, 1.0)
+        return -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
+    if loss_type == MEAN_SQUARED_ERROR:
+        return jnp.mean(jnp.square(logits - labels))
+    if loss_type == BINARY_CROSSENTROPY:
+        p = jnp.clip(logits, 1e-7, 1 - 1e-7)
+        return -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    if loss_type == IDENTITY:
+        return jnp.mean(logits)
+    raise ValueError(f"unknown loss type {loss_type!r}")

@@ -1,0 +1,45 @@
+"""Metrics computed on-device as jitted reductions.
+
+Reference: ``src/metrics_functions/metrics_functions.cc/.cu`` (per-batch CUDA
+reduction + Legion future sum).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+ACCURACY = "accuracy"
+CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+MEAN_SQUARED_ERROR = "mean_squared_error"
+
+
+def compute_metrics(
+    metric_names: List[str], logits: jax.Array, labels: jax.Array
+) -> Dict[str, jax.Array]:
+    out = {}
+    for m in metric_names:
+        if m == ACCURACY:
+            if labels.ndim == logits.ndim and labels.shape[-1] == logits.shape[-1]:
+                y = jnp.argmax(labels, axis=-1)
+            else:
+                y = labels.reshape(labels.shape[0], -1)[..., 0].astype(jnp.int32)
+            pred = jnp.argmax(logits, axis=-1)
+            out[m] = jnp.mean((pred == y).astype(jnp.float32))
+        elif m == SPARSE_CATEGORICAL_CROSSENTROPY:
+            probs = jnp.clip(logits, 1e-10, 1.0)
+            y = labels.reshape(labels.shape[0], -1)[..., 0].astype(jnp.int32)
+            out[m] = -jnp.mean(
+                jnp.take_along_axis(jnp.log(probs), y[:, None], axis=-1)
+            )
+        elif m == CATEGORICAL_CROSSENTROPY:
+            probs = jnp.clip(logits, 1e-10, 1.0)
+            out[m] = -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
+        elif m == MEAN_SQUARED_ERROR:
+            out[m] = jnp.mean(jnp.square(logits - labels))
+        else:
+            raise ValueError(f"unknown metric {m!r}")
+    return out

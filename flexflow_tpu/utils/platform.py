@@ -1,0 +1,23 @@
+"""Platform/device helpers.
+
+This environment pre-imports jax and pins ``jax_platforms`` to the TPU plugin
+at interpreter start, so a plain ``JAX_PLATFORMS=cpu`` env var is ignored.
+``force_cpu(n)`` reliably re-points JAX at n virtual CPU devices as long as no
+backend has been initialized yet (i.e. call it before any ``jax.devices()``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

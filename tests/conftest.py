@@ -1,0 +1,38 @@
+"""Hermetic multi-device testing: 8 virtual CPU devices.
+
+The reference has no fake-device backend (its tests need real GPUs; SURVEY.md
+§4); on TPU/XLA we get hermetic N-device semantics for free via
+``--xla_force_host_platform_device_count`` — every parallelism test below runs
+the real collectives on a virtual mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets JAX_PLATFORMS=axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# jax is pre-imported by the environment's sitecustomize with the TPU backend
+# selected; the backend itself is only created on first use, so this override
+# still lands as long as no devices were queried yet.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

@@ -1,0 +1,180 @@
+"""Execution tests: PCG lowering correctness, single-device vs sharded.
+
+The key hermetic guarantee the reference never had (SURVEY.md §4): every
+parallel strategy must produce numerically identical results to the
+single-device run, on real collectives over 8 virtual CPU devices — in both
+spmd (GSPMD) and local (shard_map) lowering modes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, make_mesh
+from flexflow_tpu.core.interpreter import build_forward, init_params
+from flexflow_tpu.core.pcg import PCG
+
+
+def build_mlp(mesh):
+    model = FFModel(FFConfig(), mesh=mesh)
+    x = model.create_tensor((16, 32))
+    h = model.dense(x, 64, activation="relu", name="fc1")
+    h = model.dense(h, 48, activation="relu", name="fc2")
+    out = model.softmax(model.dense(h, 8, name="fc3"))
+    return model
+
+
+def run_with_strategy(mesh, strategy, mode, x_np, seed=7):
+    model = build_mlp(mesh)
+    pcg = PCG(model.graph, mesh, strategy)
+    plan = pcg.plan()
+    fwd = build_forward(plan, mode=mode)
+    params = init_params(model.graph, plan, jax.random.PRNGKey(seed))
+    tid = model.graph.input_tids[0]
+    out = fwd(params, {tid: jnp.asarray(x_np)})
+    return np.asarray(out[0])
+
+
+@pytest.fixture(scope="module")
+def x_np():
+    rng = np.random.RandomState(3)
+    return rng.randn(16, 32).astype(np.float32)
+
+
+def test_single_device_forward(devices8, x_np):
+    mesh1 = make_mesh({"dp": 1}, devices8[:1])
+    out = run_with_strategy(mesh1, {}, "spmd", x_np)
+    assert out.shape == (16, 8)
+    np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["spmd", "local"])
+def test_dp_matches_single(devices8, x_np, mode):
+    mesh1 = make_mesh({"dp": 1}, devices8[:1])
+    ref = run_with_strategy(mesh1, {}, "spmd", x_np)
+
+    mesh = make_mesh({"dp": 8}, devices8)
+    dp = {"sample": ("dp",)}
+    strategy = {"fc1": dp, "fc2": dp, "fc3": dp, "softmax": dp}
+    out = run_with_strategy(mesh, strategy, mode, x_np)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["spmd", "local"])
+def test_tp_matches_single(devices8, x_np, mode):
+    mesh1 = make_mesh({"tp": 1}, devices8[:1])
+    ref = run_with_strategy(mesh1, {}, "spmd", x_np)
+
+    mesh = make_mesh({"tp": 8}, devices8)
+    strategy = {
+        "fc1": {"channel_out": ("tp",)},   # column-parallel
+        "fc2": {"channel_out": ("tp",)},   # stays sharded? no: fc2 needs full in
+        "fc3": {},
+    }
+    out = run_with_strategy(mesh, strategy, mode, x_np)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["spmd", "local"])
+def test_megatron_pair_matches_single(devices8, x_np, mode):
+    """Column-parallel -> row-parallel: the Megatron pattern with a partial-sum
+    output resolved by an AllReduce node the normalizer inserts."""
+    mesh1 = make_mesh({"tp": 1}, devices8[:1])
+
+    def build(mesh):
+        model = FFModel(FFConfig(), mesh=mesh)
+        x = model.create_tensor((16, 32))
+        h = model.dense(x, 64, activation="relu", name="col")
+        out = model.dense(h, 32, name="row", use_bias=True)
+        return model
+
+    model = build(mesh1)
+    plan1 = PCG(model.graph, mesh1, {}).plan()
+    fwd1 = build_forward(plan1, mode="spmd")
+    params = init_params(model.graph, plan1, jax.random.PRNGKey(11))
+    tid = model.graph.input_tids[0]
+    ref = np.asarray(fwd1(params, {tid: jnp.asarray(x_np)})[0])
+
+    mesh = make_mesh({"tp": 8}, devices8)
+    model2 = build(mesh)
+    strategy = {
+        "col": {"channel_out": ("tp",)},
+        "row": {"channel_in": ("tp",)},
+    }
+    plan2 = PCG(model2.graph, mesh, strategy).plan()
+    # verify the normalizer put an allreduce at the end (partial output)
+    kinds = [s.node.op.type_name for s in plan2.steps]
+    assert "allreduce" in kinds
+    fwd2 = build_forward(plan2, mode=mode)
+    out = np.asarray(fwd2(params, {tid: jnp.asarray(x_np)})[0])
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["spmd", "local"])
+def test_dp_tp_hybrid(devices8, x_np, mode):
+    mesh1 = make_mesh({"dp": 1}, devices8[:1])
+    ref = run_with_strategy(mesh1, {}, "spmd", x_np)
+
+    mesh = make_mesh({"dp": 4, "tp": 2}, devices8)
+    strategy = {
+        "fc1": {"sample": ("dp",), "channel_out": ("tp",)},
+        "fc2": {"sample": ("dp",), "channel_out": ("tp",)},
+        "fc3": {"sample": ("dp",)},
+        "softmax": {"sample": ("dp",)},
+    }
+    out = run_with_strategy(mesh, strategy, mode, x_np)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-6)
+
+
+def test_training_step_decreases_loss(devices8):
+    mesh = make_mesh({"dp": 4}, devices8[:4])
+    model = FFModel(FFConfig(batch_size=32, learning_rate=0.1), mesh=mesh)
+    x = model.create_tensor((32, 20))
+    h = model.dense(x, 32, activation="relu")
+    out = model.softmax(model.dense(h, 4))
+    model.compile(metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 20).astype(np.float32)
+    W = rng.randn(20, 4).astype(np.float32)
+    y = np.argmax(X @ W, axis=-1).astype(np.int32)
+
+    hist = model.fit(X, y, epochs=5, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    assert hist[-1]["accuracy"] > 0.6
+
+
+def test_grads_match_between_dp_and_single(devices8):
+    """DP gradient == single-device gradient (GSPMD emits the psum)."""
+
+    def build_and_grad(mesh, strategy):
+        model = FFModel(FFConfig(), mesh=mesh)
+        x = model.create_tensor((8, 12))
+        out = model.softmax(model.dense(x, 4, name="fc"))
+        pcg = PCG(model.graph, mesh, strategy)
+        plan = pcg.plan()
+        fwd = build_forward(plan, "spmd")
+        params = init_params(model.graph, plan, jax.random.PRNGKey(5))
+        tid = model.graph.input_tids[0]
+        rng = np.random.RandomState(1)
+        xb = jnp.asarray(rng.randn(8, 12).astype(np.float32))
+        yb = jnp.asarray(rng.randint(0, 4, size=(8,)))
+
+        def loss_fn(p):
+            probs = fwd(p, {tid: xb})[0]
+            ll = jnp.take_along_axis(
+                jnp.log(jnp.clip(probs, 1e-10, 1)), yb[:, None], axis=-1
+            )
+            return -jnp.mean(ll)
+
+        return jax.grad(loss_fn)(params)
+
+    g1 = build_and_grad(make_mesh({"dp": 1}, devices8[:1]), {})
+    g8 = build_and_grad(
+        make_mesh({"dp": 8}, devices8), {"fc": {"sample": ("dp",)}, "softmax": {"sample": ("dp",)}}
+    )
+    for k in g1["fc"]:
+        np.testing.assert_allclose(
+            np.asarray(g1["fc"][k]), np.asarray(g8["fc"][k]), rtol=2e-5, atol=1e-6
+        )
