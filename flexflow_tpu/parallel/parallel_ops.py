@@ -35,6 +35,20 @@ def _axes_degree(axes: Tuple[str, ...], mesh) -> int:
     return d
 
 
+def _local_nbytes(spec: "TensorSpec", sh: TensorSharding, mesh,
+                  exclude_axes: Tuple[str, ...] = ()) -> float:
+    """Per-device bytes of a tensor under sharding ``sh`` (dims only; the
+    ``exclude_axes`` are treated as unsharded — used to ask "how big is the
+    shard from the collective's own point of view")."""
+    deg = 1
+    shape = dict(mesh.shape)
+    for d in sh.dims:
+        for a in d.axes:
+            if a not in exclude_axes:
+                deg *= shape[a]
+    return spec.nbytes() / deg
+
+
 def _constrain(ctx: OpContext, x: jax.Array, sharding: TensorSharding) -> jax.Array:
     if ctx.mesh is None:
         return x
@@ -162,7 +176,8 @@ class Combine(ParallelOp):
 
     def comm_bytes(self, spec, sh_in, mesh) -> int:
         deg = _axes_degree(self.axes, mesh)
-        return int(spec.nbytes() * (deg - 1) / max(deg, 1))
+        full = _local_nbytes(spec, sh_in, mesh, exclude_axes=self.axes)
+        return int(full * (deg - 1) / max(deg, 1))
 
 
 @register_op
@@ -198,7 +213,8 @@ class Reduction(ParallelOp):
 
     def comm_bytes(self, spec, sh_in, mesh) -> int:
         deg = _axes_degree(self.axes, mesh)
-        return int(spec.nbytes() * (deg - 1) / max(deg, 1))
+        local = _local_nbytes(spec, sh_in, mesh)
+        return int(local * (deg - 1) / max(deg, 1))
 
 
 @register_op
@@ -229,7 +245,8 @@ class AllReduce(ParallelOp):
 
     def comm_bytes(self, spec, sh_in, mesh) -> int:
         deg = _axes_degree(self.axes, mesh)
-        return int(2 * spec.nbytes() * (deg - 1) / max(deg, 1))
+        local = _local_nbytes(spec, sh_in, mesh)
+        return int(2 * local * (deg - 1) / max(deg, 1))
 
 
 @register_op
@@ -272,8 +289,8 @@ class AllToAll(ParallelOp):
 
     def comm_bytes(self, spec, sh_in, mesh) -> int:
         deg = _axes_degree(self.axes, mesh)
-        local_bytes = spec.nbytes() // max(deg, 1)
-        return int(local_bytes * (deg - 1) / max(deg, 1))
+        local = _local_nbytes(spec, sh_in, mesh)
+        return int(local * (deg - 1) / max(deg, 1))
 
 
 def reshard_path(

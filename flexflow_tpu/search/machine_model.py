@@ -1,0 +1,109 @@
+"""TPU machine model: compute roofline + ICI/DCN communication costs.
+
+Reference: ``src/runtime/machine_model.cc`` (``SimpleMachineModel`` /
+``EnhancedMachineModel`` describing PCIe/NVLink/IB bandwidths).  The TPU
+analogue describes per-chip peak FLOPs + HBM bandwidth and the ICI torus
+links within a slice (DCN across slices).  Numbers are calibratable: the
+microbenchmark harness (``measure.py``) can overwrite the analytical guesses
+with measured values — the ``[B]`` "recalibrate the simulator" requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class TPUSpec:
+    name: str
+    peak_flops_bf16: float      # FLOP/s per chip
+    peak_flops_f32: float
+    hbm_bandwidth: float        # bytes/s
+    ici_bandwidth: float        # bytes/s per link direction
+    ici_latency: float          # seconds per hop
+    dcn_bandwidth: float        # bytes/s per host
+    dcn_latency: float
+    kernel_overhead: float = 2e-6   # per-op dispatch overhead inside a program
+
+
+TPU_SPECS: Dict[str, TPUSpec] = {
+    # public spec-sheet numbers (approximate; calibrate on real hardware)
+    "v5e": TPUSpec(
+        name="v5e",
+        peak_flops_bf16=197e12,
+        peak_flops_f32=98.5e12,
+        hbm_bandwidth=819e9,
+        ici_bandwidth=0.2e12,      # 1.6 Tbps total / 8 ≈ per-direction-link bytes
+        ici_latency=1e-6,
+        dcn_bandwidth=25e9,
+        dcn_latency=10e-6,
+    ),
+    "v5p": TPUSpec(
+        name="v5p",
+        peak_flops_bf16=459e12,
+        peak_flops_f32=229.5e12,
+        hbm_bandwidth=2765e9,
+        ici_bandwidth=0.6e12,
+        ici_latency=1e-6,
+        dcn_bandwidth=25e9,
+        dcn_latency=10e-6,
+    ),
+    # virtual CPU mesh for hermetic tests: only relative costs matter
+    "cpu": TPUSpec(
+        name="cpu",
+        peak_flops_bf16=200e9,
+        peak_flops_f32=100e9,
+        hbm_bandwidth=20e9,
+        ici_bandwidth=5e9,
+        ici_latency=5e-6,
+        dcn_bandwidth=1e9,
+        dcn_latency=50e-6,
+    ),
+}
+
+
+@dataclasses.dataclass
+class MachineModel:
+    """Cost oracle for one mesh: compute roofline + collective time."""
+
+    spec: TPUSpec
+    # mesh axes laid out over ICI by default; axes listed here ride DCN
+    dcn_axes: frozenset = frozenset()
+
+    @staticmethod
+    def for_mesh(mesh, spec_name: Optional[str] = None,
+                 dcn_axes=()) -> "MachineModel":
+        if spec_name is None:
+            plat = mesh.devices.flat[0].platform if mesh.size else "cpu"
+            spec_name = {"tpu": "v5e", "cpu": "cpu"}.get(plat, "v5e")
+        return MachineModel(TPU_SPECS[spec_name], frozenset(dcn_axes))
+
+    # ---- compute ------------------------------------------------------
+    def compute_time(self, flops: float, bytes_accessed: float,
+                     dtype_bits: int = 32) -> float:
+        peak = (
+            self.spec.peak_flops_bf16
+            if dtype_bits <= 16
+            else self.spec.peak_flops_f32
+        )
+        return max(flops / peak, bytes_accessed / self.spec.hbm_bandwidth) + (
+            self.spec.kernel_overhead
+        )
+
+    # ---- communication ------------------------------------------------
+    def collective_time(self, comm_bytes_per_device: float, axes, mesh) -> float:
+        """Ring-model time for a collective moving ``comm_bytes_per_device``
+        over the given mesh axes (the per-op ``comm_bytes`` hook supplies the
+        bytes; (deg-1)/deg factors are already baked in there)."""
+        if comm_bytes_per_device <= 0:
+            return 0.0
+        deg = 1
+        for a in axes:
+            deg *= mesh.shape[a]
+        if deg <= 1:
+            return 0.0
+        on_dcn = any(a in self.dcn_axes for a in axes)
+        bw = self.spec.dcn_bandwidth if on_dcn else self.spec.ici_bandwidth
+        lat = self.spec.dcn_latency if on_dcn else self.spec.ici_latency
+        return comm_bytes_per_device / bw + (deg - 1) * lat

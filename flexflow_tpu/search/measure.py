@@ -1,0 +1,150 @@
+"""On-device microbenchmark harness: the ``measure_operator_cost`` analog.
+
+Reference: ``Simulator::measure_operator_cost`` in ``src/runtime/simulator.cc``
+— run each op's kernel a few times on the real device, cache by op signature.
+Here each probe is a jitted single-op function on the op's *local* shapes,
+timed after compile, cached to JSON so search runs don't re-measure.
+
+CLI: ``python -m flexflow_tpu.search.measure`` calibrates the standard probe
+set on whatever device is visible and writes ``~/.flexflow_tpu_costs.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import TensorSpec
+from ..core.op import OpContext
+
+DEFAULT_CACHE = os.path.expanduser("~/.flexflow_tpu_costs.json")
+
+
+def _key_str(key) -> str:
+    return repr(key)
+
+
+class CostCache:
+    """{(op_signature, local_in_shapes) -> seconds} with JSON persistence."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or DEFAULT_CACHE
+        self.data: Dict = {}
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    self.data = {k: v for k, v in json.load(f).items()}
+            except (json.JSONDecodeError, OSError):
+                self.data = {}
+
+    def get(self, key, default=None):
+        return self.data.get(_key_str(key), default)
+
+    def __contains__(self, key) -> bool:
+        return _key_str(key) in self.data
+
+    def __getitem__(self, key):
+        return self.data[_key_str(key)]
+
+    def put(self, key, seconds: float):
+        self.data[_key_str(key)] = seconds
+
+    def save(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.data, f, indent=1)
+        os.replace(tmp, self.path)
+
+
+def time_fn(fn, args, iters: int = 10, warmup: int = 2) -> float:
+    """Median wall time of a jitted callable (post-compile)."""
+    jitted = jax.jit(fn)
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    for _ in range(warmup):
+        out = jitted(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jitted(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def measure_operator_cost(
+    op,
+    local_in_specs: List[TensorSpec],
+    cache: Optional[CostCache] = None,
+    iters: int = 10,
+) -> float:
+    """Time one op's forward on its local shapes on the current device."""
+    key = (op.attr_signature(), tuple(s.shape for s in local_in_specs))
+    if cache is not None:
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+
+    rng = np.random.RandomState(0)
+    args = []
+    for s in local_in_specs:
+        if jnp.issubdtype(jnp.dtype(s.dtype), jnp.integer):
+            args.append(jnp.asarray(rng.randint(0, 2, size=s.shape), s.dtype))
+        else:
+            args.append(jnp.asarray(rng.randn(*s.shape), s.dtype))
+
+    params = {}
+    for p in op.params():
+        params[p.name] = jnp.asarray(
+            rng.randn(*p.spec.shape).astype(np.float32), p.spec.dtype
+        )
+
+    ctx = OpContext(mode="spmd", mesh=None, training=False)
+
+    def fn(inputs, params):
+        return op.lower(ctx, list(inputs), params)
+
+    t = time_fn(fn, (tuple(args), params), iters=iters)
+    if cache is not None:
+        cache.put(key, t)
+    return t
+
+
+def calibrate_standard_probes(cache_path: Optional[str] = None) -> CostCache:
+    """Measure a spread of Linear/matmul/norm shapes to anchor the roofline."""
+    from ..ops.linear import Linear
+    from ..ops.norm import LayerNorm, RMSNorm
+
+    cache = CostCache(cache_path)
+    shapes = [
+        (64, 512, 512),
+        (64, 512, 2048),
+        (256, 1024, 1024),
+        (512, 4096, 4096),
+        (1024, 4096, 11008),
+    ]
+    for b, i, o in shapes:
+        op = Linear(o, use_bias=True, in_dim=i)
+        op.infer_shapes([TensorSpec((b, i))])
+        t = measure_operator_cost(op, [TensorSpec((b, i))], cache)
+        print(f"linear b={b} in={i} out={o}: {t * 1e6:.1f}us "
+              f"({2 * b * i * o / t / 1e12:.2f} TFLOP/s)")
+    for b, d in [(64, 512), (256, 4096), (1024, 4096)]:
+        for op in (LayerNorm(d), RMSNorm(d)):
+            op.infer_shapes([TensorSpec((b, d))])
+            t = measure_operator_cost(op, [TensorSpec((b, d))], cache)
+            print(f"{op.type_name} b={b} d={d}: {t * 1e6:.1f}us")
+    cache.save()
+    print(f"saved {len(cache.data)} measurements to {cache.path}")
+    return cache
+
+
+if __name__ == "__main__":
+    calibrate_standard_probes()

@@ -1,0 +1,145 @@
+"""Unity-style auto-parallelization search.
+
+Reference: ``graph_optimize`` / ``GraphSearchHelper`` / ``FFModel::optimize``
+in ``src/runtime/graph.cc``/``model.cc`` `[B: "MCMC strategy search"]` — joint
+exploration of parallelization choices guided by the simulator.  Here the
+search space is, per op, an assignment of mesh axes to the op's declared
+parallel dims (the MachineView analog); candidates are enumerated up front,
+the Metropolis/MCMC walk proposes single-op config changes, and the simulator
+(roofline + ICI model, optionally calibrated by measured probes) scores whole
+plans — resharding nodes inserted by the PCG normalizer are costed as the
+communication they will actually become.
+
+Algebraic substitutions (operator fusion rewrites) are a separate pass; the
+parallelization search below is the part that replaces hand-written
+``in_specs`` and is Unity's headline capability.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.graph import Graph
+from ..core.pcg import PCG
+from ..parallel.mesh import data_parallel_strategy
+from .machine_model import MachineModel
+from .simulator import simulate
+
+Config = Dict[str, Tuple[str, ...]]
+
+
+def enumerate_op_configs(node, in_specs, mesh) -> List[Config]:
+    """All valid mesh-axis -> parallel-dim assignments for one op."""
+    pdims = node.op.parallel_dims(in_specs)  # {name: extent}
+    axes = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+    if not axes or not pdims:
+        return [{}]
+    names = list(pdims.keys())
+    configs: List[Config] = []
+    seen = set()
+    for assign in itertools.product([None] + names, repeat=len(axes)):
+        cfg: Dict[str, Tuple[str, ...]] = {}
+        for axis, pd in zip(axes, assign):
+            if pd is not None:
+                cfg.setdefault(pd, ())
+                cfg[pd] = cfg[pd] + (axis,)
+        # divisibility: each parallel dim's extent divides its total degree
+        ok = True
+        for pd, ax in cfg.items():
+            deg = int(np.prod([mesh.shape[a] for a in ax]))
+            if pdims[pd] % deg != 0:
+                ok = False
+                break
+        if not ok:
+            continue
+        try:
+            node_in = list(in_specs)
+            node.op.apply_config(cfg, node_in, mesh)
+        except (ValueError, KeyError):
+            continue
+        key = tuple(sorted((k, v) for k, v in cfg.items()))
+        if key not in seen:
+            seen.add(key)
+            configs.append(cfg)
+    return configs or [{}]
+
+
+def graph_optimize(
+    graph: Graph,
+    mesh,
+    budget: int = 500,
+    alpha: float = 0.05,
+    machine: Optional[MachineModel] = None,
+    measured: Optional[Dict] = None,
+    seed: int = 0,
+    init: Optional[Dict[str, Config]] = None,
+    training: bool = True,
+    verbose: bool = False,
+) -> Dict[str, Config]:
+    """MCMC search over per-op parallel configs; returns the best strategy."""
+    rng = random.Random(seed)
+    mm = machine or MachineModel.for_mesh(mesh)
+
+    searchable = []
+    candidates: Dict[str, List[Config]] = {}
+    for node in graph.nodes:
+        in_specs = [graph.spec(t) for t in node.inputs]
+        cands = enumerate_op_configs(node, in_specs, mesh)
+        candidates[node.name] = cands
+        if len(cands) > 1:
+            searchable.append(node.name)
+
+    def cost_of(strategy) -> float:
+        plan = PCG(graph, mesh, strategy).plan()
+        return simulate(plan, mm, training=training, measured=measured).total
+
+    state = dict(init if init is not None else data_parallel_strategy(graph, mesh))
+    try:
+        cur_cost = cost_of(state)
+    except (ValueError, AssertionError):
+        state = {}
+        cur_cost = cost_of(state)
+    best, best_cost = dict(state), cur_cost
+    if verbose:
+        print(f"search: start cost {cur_cost * 1e3:.3f}ms, "
+              f"{len(searchable)} searchable ops, budget {budget}")
+
+    if not searchable:
+        return best
+
+    accepted = 0
+    for it in range(budget):
+        name = rng.choice(searchable)
+        cand = rng.choice(candidates[name])
+        if cand == state.get(name, {}):
+            continue
+        proposal = dict(state)
+        if cand:
+            proposal[name] = cand
+        else:
+            proposal.pop(name, None)
+        try:
+            new_cost = cost_of(proposal)
+        except (ValueError, AssertionError):
+            continue
+        # Metropolis criterion (reference: FFModel::optimize MCMC)
+        if new_cost < cur_cost or rng.random() < math.exp(
+            (cur_cost - new_cost) / max(alpha * cur_cost, 1e-12)
+        ):
+            state, cur_cost = proposal, new_cost
+            accepted += 1
+            if cur_cost < best_cost:
+                best, best_cost = dict(state), cur_cost
+                if verbose:
+                    print(f"  it {it}: best {best_cost * 1e3:.3f}ms "
+                          f"({name} -> {cand})")
+
+    if verbose:
+        print(f"search: done, best {best_cost * 1e3:.3f}ms "
+              f"({accepted}/{budget} accepted)")
+    return best

@@ -1,0 +1,160 @@
+"""Simulator: predict the per-iteration cost of a planned PCG.
+
+Reference: ``src/runtime/simulator.cc`` — ``Simulator::simulate_runtime``
+builds a task graph of per-op measured costs + comm edges and event-simulates
+it.  Differences here, on purpose:
+
+* XLA executes one fused program per step, so a serial walk over plan steps
+  with an overlap discount models reality better than a Legion-style task
+  event sim; compute comes from a roofline over *local* (per-device) shapes.
+* Per-op **measured** costs (the ``measure_operator_cost`` analog in
+  ``measure.py``) override the roofline when a calibration cache is present.
+* Training cost = forward + backward (≈2× forward flops) + gradient
+  all-reduce for replicated params whose op shards the batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.pcg import Plan, Step
+from .machine_model import MachineModel
+
+
+@dataclasses.dataclass
+class CostBreakdown:
+    compute: float = 0.0
+    comm: float = 0.0
+    grad_comm: float = 0.0
+
+    @property
+    def total(self) -> float:
+        return self.compute + self.comm + self.grad_comm
+
+    def __str__(self):
+        return (
+            f"total={self.total * 1e3:.3f}ms (compute={self.compute * 1e3:.3f} "
+            f"comm={self.comm * 1e3:.3f} grad={self.grad_comm * 1e3:.3f})"
+        )
+
+
+def _local_size(spec, sh, mesh) -> int:
+    try:
+        shape = sh.local_shape(spec.shape, mesh)
+    except ValueError:
+        shape = spec.shape
+    return int(np.prod(shape)) if shape else 1
+
+
+def _step_compute_time(step: Step, mesh, mm: MachineModel,
+                       measured: Optional[Dict] = None,
+                       training: bool = True) -> float:
+    op = step.node.op
+    # measured-cost cache lookup (op signature + local shapes); ``measured``
+    # is a CostCache (repr-string keys) or any mapping supporting __contains__
+    if measured is not None:
+        key = _measure_key(step, mesh)
+        if key in measured:
+            t = measured[key]
+            return t * (3.0 if training else 1.0)
+
+    # analytical roofline on local shapes: scale global flops by the
+    # fraction of the output each device owns (+ partial-dim contraction)
+    global_flops = op.flops(step.in_specs)
+    shard_frac = 1.0
+    if step.out_specs:
+        g = int(np.prod(step.out_specs[0].shape)) or 1
+        l = _local_size(step.out_specs[0], step.out_shardings[0], mesh)
+        shard_frac = l / g
+        # contracted-dim sharding (partial output) further divides the flops
+        for a in step.out_shardings[0].partial_axes:
+            shard_frac /= mesh.shape[a]
+    flops = global_flops * shard_frac
+
+    bytes_accessed = 0
+    for spec, sh in zip(step.in_specs, step.in_shardings):
+        bytes_accessed += _local_size(spec, sh, mesh) * spec.nbytes() // max(spec.size, 1)
+    for spec, sh in zip(step.out_specs, step.out_shardings):
+        bytes_accessed += _local_size(spec, sh, mesh) * spec.nbytes() // max(spec.size, 1)
+
+    dtype_bits = 8 * (step.out_specs[0].nbytes() // max(step.out_specs[0].size, 1)) if step.out_specs else 32
+    fwd = mm.compute_time(flops, bytes_accessed, dtype_bits)
+    # backward ≈ 2× forward flops (dX and dW matmuls); elementwise ≈ 1×
+    return fwd * (3.0 if training else 1.0)
+
+
+def _measure_key(step: Step, mesh):
+    local_in = tuple(
+        sh.local_shape(spec.shape, mesh)
+        for spec, sh in zip(step.in_specs, step.in_shardings)
+    )
+    return (step.node.op.attr_signature(), local_in)
+
+
+def simulate(
+    plan: Plan,
+    machine: Optional[MachineModel] = None,
+    training: bool = True,
+    measured: Optional[Dict] = None,
+    overlap: float = 0.3,
+) -> CostBreakdown:
+    """Predict one iteration's wall time for this plan.
+
+    ``overlap``: fraction of communication hidden behind compute (XLA async
+    collectives overlap well when compute is abundant; 0 = fully serial).
+    """
+    mesh = plan.mesh
+    mm = machine or MachineModel.for_mesh(mesh)
+    cost = CostBreakdown()
+
+    for step in plan.steps:
+        if step.is_parallel:
+            op = step.node.op
+            b = op.comm_bytes(step.in_specs[0], step.in_shardings[0], mesh)
+            t = mm.collective_time(b, getattr(op, "axes", ()), mesh)
+            if training:
+                # the reshard's transpose appears in backward too
+                t *= 2.0
+            cost.comm += t
+        else:
+            cost.compute += _step_compute_time(step, mesh, mm, measured, training)
+
+    if training:
+        # gradient all-reduce: params replicated over axes that shard the
+        # op's batch get a psum of their gradient (GSPMD inserts it; the
+        # reference's NCCL allreduce stage)
+        for step in plan.steps:
+            if step.is_parallel or not step.config:
+                continue
+            batch_axes = tuple(step.config.get("sample", ()))
+            if not batch_axes:
+                continue
+            pshs = plan.param_shardings.get(step.node.name, {})
+            ps = {p.name: p for p in step.node.op.params()}
+            for pname, sh in pshs.items():
+                if not ps.get(pname) or not ps[pname].trainable:
+                    continue
+                axes = tuple(a for a in batch_axes if a not in sh.used_axes())
+                if not axes:
+                    continue
+                spec = ps[pname].spec
+                deg = 1
+                for a in axes:
+                    deg *= mesh.shape[a]
+                local_bytes = _local_size(spec, sh, mesh) * (
+                    spec.nbytes() // max(spec.size, 1)
+                )
+                b = 2 * local_bytes * (deg - 1) / deg
+                cost.grad_comm += mm.collective_time(b, axes, mesh)
+
+    hidden = min(cost.comm + cost.grad_comm, cost.compute) * overlap
+    total_comm = cost.comm + cost.grad_comm - hidden
+    # fold the discount proportionally so the breakdown still sums to total
+    if cost.comm + cost.grad_comm > 0:
+        scale = total_comm / (cost.comm + cost.grad_comm)
+        cost.comm *= scale
+        cost.grad_comm *= scale
+    return cost

@@ -1,0 +1,37 @@
+"""Strategy import/export: JSON op->parallel-config maps.
+
+Reference: FlexFlow's ``--import``/``--export`` strategy files (serialized
+per-op ``ParallelConfig``/MachineView maps cached between runs).  Format:
+
+{
+  "mesh": {"dp": 4, "tp": 2},            # informational
+  "ops": {"dense_1": {"sample": ["dp"], "channel_out": ["tp"]}, ...}
+}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+
+def save_strategy(path: str, strategy: Dict[str, Dict], mesh=None) -> None:
+    doc = {
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "ops": {
+            name: {k: list(v) for k, v in cfg.items()}
+            for name, cfg in strategy.items()
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+
+
+def load_strategy(path: str) -> Dict[str, Dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    ops = doc.get("ops", doc)  # tolerate bare {name: cfg} files
+    return {
+        name: {k: tuple(v) for k, v in cfg.items()}
+        for name, cfg in ops.items()
+    }
