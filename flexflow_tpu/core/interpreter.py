@@ -39,13 +39,24 @@ def build_forward(plan: Plan, mode: str = "spmd") -> Callable:
     ``inputs``: ``{tid: array}`` for every graph input (global arrays).
     In either mode the returned function takes and returns GLOBAL arrays and is
     safe to ``jax.jit`` / differentiate.
+
+    Stateful execution (the serve path — KV caches): pass ``state`` (a dict
+    ``{node_name: pytree}``) and optionally ``extras`` (shared values visible
+    to every op, e.g. the ``BatchConfig``).  Ops marked ``stateful = True``
+    receive their state at ``ctx.extras["state"]`` and publish the updated
+    state to ``ctx.extras["state_out"]``; the call then returns
+    ``(outputs, new_state)``.  This replaces the reference's mutable per-op
+    ``OpMeta`` device state (e.g. ``IncMultiHeadSelfAttentionMeta``'s KV cache)
+    with explicit functional threading so the whole step stays jittable and
+    the caches can be donated.
     """
 
     mesh = plan.mesh
     trivial = _mesh_is_trivial(mesh)
 
-    def body(params, inputs, rng, training):
+    def body(params, inputs, rng, training, state=None, extras=None):
         env: Dict[int, jax.Array] = {}
+        new_state = {} if state is not None else None
         for tid, vid in plan.input_vids.items():
             env[vid] = inputs[tid]
         for i, step in enumerate(plan.steps):
@@ -65,8 +76,14 @@ def build_forward(plan: Plan, mode: str = "spmd") -> Callable:
                     "out_specs": step.out_specs,
                 },
             )
+            if extras:
+                ctx.extras.update(extras)
+            if state is not None and getattr(step.node.op, "stateful", False):
+                ctx.extras["state"] = state.get(step.node.name)
             args = [env[v] for v in step.in_vids]
             outs = step.node.op.lower(ctx, args, params.get(step.node.name, {}))
+            if new_state is not None and "state_out" in ctx.extras:
+                new_state[step.node.name] = ctx.extras["state_out"]
             if mode == "spmd" and not trivial and not step.is_parallel:
                 outs = [
                     _constrain_spmd(o, sh, mesh)
@@ -74,12 +91,15 @@ def build_forward(plan: Plan, mode: str = "spmd") -> Callable:
                 ]
             for v, o in zip(step.out_vids, outs):
                 env[v] = o
-        return [env[v] for v in plan.output_vids]
+        outputs = [env[v] for v in plan.output_vids]
+        if state is not None:
+            return outputs, new_state
+        return outputs
 
     if mode == "spmd" or trivial:
 
-        def fn(params, inputs, rng=None, training=False):
-            return body(params, inputs, rng, training)
+        def fn(params, inputs, rng=None, training=False, state=None, extras=None):
+            return body(params, inputs, rng, training, state, extras)
 
         return fn
 

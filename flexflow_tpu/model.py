@@ -256,6 +256,46 @@ class FFModel:
     def beam_top_k(self, x, max_beam_width, name=None):
         return self._add(BeamTopK(max_beam_width), [x], name or "beam_top_k")
 
+    # attention (serving): KV-cached / speculative / tree-verify variants.
+    # Reference: FFModel::inc_multihead_self_attention and friends in
+    # src/runtime/model.cc; these require running under the InferenceManager
+    # (which supplies the BatchConfig + cache state each step).
+    def inc_multihead_self_attention(self, x, embed_dim, num_q_heads,
+                                     num_kv_heads=None, head_dim=None,
+                                     rotary_embedding=True, rope_theta=10000.0,
+                                     use_bias=False, scaling_factor=None,
+                                     name=None):
+        from .serve.ops import IncMultiHeadSelfAttention
+
+        op = IncMultiHeadSelfAttention(
+            embed_dim, num_q_heads, num_kv_heads, head_dim, rotary_embedding,
+            rope_theta, use_bias, scaling_factor, dtype=x.dtype)
+        return self._add(op, [x], name or "inc_mha")[0]
+
+    def spec_inc_multihead_self_attention(self, x, embed_dim, num_q_heads,
+                                          num_kv_heads=None, head_dim=None,
+                                          rotary_embedding=True,
+                                          rope_theta=10000.0, use_bias=False,
+                                          scaling_factor=None, name=None):
+        from .serve.ops import SpecIncMultiHeadSelfAttention
+
+        op = SpecIncMultiHeadSelfAttention(
+            embed_dim, num_q_heads, num_kv_heads, head_dim, rotary_embedding,
+            rope_theta, use_bias, scaling_factor, dtype=x.dtype)
+        return self._add(op, [x], name or "spec_inc_mha")[0]
+
+    def tree_inc_multihead_self_attention(self, x, embed_dim, num_q_heads,
+                                          num_kv_heads=None, head_dim=None,
+                                          rotary_embedding=True,
+                                          rope_theta=10000.0, use_bias=False,
+                                          scaling_factor=None, name=None):
+        from .serve.ops import TreeIncMultiHeadSelfAttention
+
+        op = TreeIncMultiHeadSelfAttention(
+            embed_dim, num_q_heads, num_kv_heads, head_dim, rotary_embedding,
+            rope_theta, use_bias, scaling_factor, dtype=x.dtype)
+        return self._add(op, [x], name or "tree_inc_mha")[0]
+
     # attention (training); serve attention ops live in flexflow_tpu.serve
     def multihead_attention(self, query, key, value, embed_dim, num_heads,
                             kdim=None, vdim=None, dropout=0.0, use_bias=True,

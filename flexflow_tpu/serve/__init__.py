@@ -1,0 +1,49 @@
+"""FlexFlow-TPU Serve: LLM serving runtime.
+
+Reference stack: ``src/runtime/{request_manager,inference_manager,
+batch_config}.cc`` + ``inference/models/*`` + ``python/flexflow/serve``.
+"""
+
+from .batch_config import (
+    BatchConfig,
+    InferenceResult,
+    TreeSearchBatchConfig,
+    TreeVerifyBatchConfig,
+    MAX_NUM_REQUESTS,
+    MAX_NUM_TOKENS,
+    MAX_SPEC_TREE_TOKENS,
+)
+from .inference_manager import InferenceManager, tensor_parallel_strategy
+from .models.base import MODEL_REGISTRY, ServeModelConfig, build_model
+from .ops import (
+    IncMultiHeadSelfAttention,
+    SpecIncMultiHeadSelfAttention,
+    TreeIncMultiHeadSelfAttention,
+)
+from .request_manager import (
+    GenerationConfig,
+    Request,
+    RequestManager,
+    RequestStatus,
+)
+
+from . import models  # noqa: F401  (registers model builders)
+
+__all__ = [
+    "BatchConfig",
+    "TreeSearchBatchConfig",
+    "TreeVerifyBatchConfig",
+    "InferenceResult",
+    "InferenceManager",
+    "tensor_parallel_strategy",
+    "RequestManager",
+    "Request",
+    "RequestStatus",
+    "GenerationConfig",
+    "ServeModelConfig",
+    "build_model",
+    "MODEL_REGISTRY",
+    "IncMultiHeadSelfAttention",
+    "SpecIncMultiHeadSelfAttention",
+    "TreeIncMultiHeadSelfAttention",
+]

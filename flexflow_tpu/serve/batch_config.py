@@ -1,0 +1,154 @@
+"""Fixed-capacity batch descriptors shipped to the device each serving step.
+
+The TPU-native analogue of FlexFlow's ``BatchConfig`` family (reference:
+``include/flexflow/batch_config.h``, ``src/runtime/batch_config.cc`` and the
+beam/tree variants): a POD struct of fixed-size arrays describing which
+requests and tokens are in flight.  The reference ships it to every GPU as a
+Legion future each step; here it is a JAX pytree of small arrays passed into
+the jitted decode step.  Fixed capacities are a *feature* on TPU: every step
+has identical shapes, so XLA compiles the decode program exactly once.
+
+Layout follows the reference's flat-token design: a step processes up to
+``max_tokens`` tokens belonging to up to ``max_requests`` request slots;
+per-token arrays say which slot each token belongs to and at which absolute
+sequence position it sits.  Prefill (many tokens of one request) and decode
+(one token per request) ride the same struct — the continuous-batching mix
+FlexFlow's RequestManager produces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Capacity defaults (analogous to the reference's BatchConfig constants).
+MAX_NUM_REQUESTS = 8
+MAX_NUM_TOKENS = 64
+MAX_SPEC_TREE_TOKENS = 64
+
+
+def _field(**meta):
+    return dataclasses.field(metadata=meta)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BatchConfig:
+    """One incremental-decoding step's worth of work.
+
+    All arrays are capacity-padded; ``num_tokens`` marks the valid prefix.
+    Padding token slots carry ``request_index == -1`` so their writes land in
+    a scratch cache row and their logits are ignored.
+    """
+
+    tokens: jax.Array           # i32[max_tokens] input token ids
+    request_index: jax.Array    # i32[max_tokens] slot per token (-1 = pad)
+    token_position: jax.Array   # i32[max_tokens] absolute seq position
+    num_tokens: jax.Array       # i32[] valid token count
+    seq_lens: jax.Array         # i32[max_requests] cache depth AFTER this step
+
+    @property
+    def max_tokens(self) -> int:
+        return self.tokens.shape[0]
+
+    @property
+    def max_requests(self) -> int:
+        return self.seq_lens.shape[0]
+
+    @staticmethod
+    def build(
+        token_ids,
+        request_indices,
+        positions,
+        seq_lens,
+        max_tokens: int = MAX_NUM_TOKENS,
+        max_requests: int = MAX_NUM_REQUESTS,
+    ) -> "BatchConfig":
+        """Host-side constructor from variable-length lists (pads to capacity)."""
+        n = len(token_ids)
+        if n > max_tokens:
+            raise ValueError(f"{n} tokens > capacity {max_tokens}")
+        tokens = np.zeros(max_tokens, np.int32)
+        req = np.full(max_tokens, -1, np.int32)
+        pos = np.zeros(max_tokens, np.int32)
+        tokens[:n] = token_ids
+        req[:n] = request_indices
+        pos[:n] = positions
+        sl = np.zeros(max_requests, np.int32)
+        sl[: len(seq_lens)] = seq_lens
+        return BatchConfig(
+            tokens=jnp.asarray(tokens),
+            request_index=jnp.asarray(req),
+            token_position=jnp.asarray(pos),
+            num_tokens=jnp.asarray(n, jnp.int32),
+            seq_lens=jnp.asarray(sl),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TreeSearchBatchConfig:
+    """Draft-model (SSM) tree-expansion step.
+
+    Reference: ``BeamSearchBatchConfig``.  The step's tokens are nodes being
+    added to each request's speculation tree; ``spec_index`` is the node's
+    index within the per-request tree buffer, ``ancestor_mask[r, i, j]`` says
+    tree node ``i`` of request ``r`` may attend tree node ``j`` (its root-path
+    ancestors and itself).  Committed-cache attention stays causal on
+    ``token_position``.
+    """
+
+    base: BatchConfig
+    spec_index: jax.Array     # i32[max_tokens] tree-node slot per step token
+    ancestor_mask: jax.Array  # bool[max_requests, max_spec, max_spec]
+    committed_lens: jax.Array  # i32[max_requests] committed cache depth
+
+    @property
+    def max_spec_tokens(self) -> int:
+        return self.ancestor_mask.shape[-1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TreeVerifyBatchConfig:
+    """LLM verification step over flattened speculation trees.
+
+    Reference: ``TreeVerifyBatchConfig``.  Same tree-attention layout as
+    :class:`TreeSearchBatchConfig` — the whole tree arrives in ONE step and is
+    verified with the tree-topology causal mask — plus the commit descriptor:
+    tokens accepted in the *previous* macro-step whose KV (saved in the spec
+    buffer) must be copied into the committed cache before attending.
+    """
+
+    base: BatchConfig
+    spec_index: jax.Array      # i32[max_tokens]
+    ancestor_mask: jax.Array   # bool[max_requests, max_spec, max_spec]
+    committed_lens: jax.Array  # i32[max_requests]
+    # commit descriptor (flat, capacity-padded, request_index -1 = pad):
+    commit_request_index: jax.Array  # i32[max_commit]
+    commit_src_spec_index: jax.Array  # i32[max_commit] slot in spec buffer
+    commit_dst_position: jax.Array   # i32[max_commit] cache position to fill
+
+    @property
+    def max_spec_tokens(self) -> int:
+        return self.ancestor_mask.shape[-1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class InferenceResult:
+    """Per-step device output consumed by the RequestManager.
+
+    Reference: ``InferenceResult`` (token ids produced for each flat token
+    slot).  ``logprobs``/``topk`` are optional extensions used by sampling and
+    speculation.
+    """
+
+    token_ids: jax.Array   # i32[max_tokens] next-token id per flat slot
+    logits_max: jax.Array  # f32[max_tokens] (argmax logit, diagnostics)
+    topk_ids: Optional[jax.Array] = None     # i32[max_tokens, k]
+    topk_logprobs: Optional[jax.Array] = None  # f32[max_tokens, k]

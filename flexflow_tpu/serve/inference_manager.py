@@ -1,0 +1,183 @@
+"""InferenceManager: compile a serve PCG and run per-step inference.
+
+Reference: ``src/runtime/inference_manager.cc`` —
+``compile_model_and_allocate_buffer`` (placement + activation/KV buffers) and
+``inference()`` (per-layer dispatch).  Here compilation is: plan the PCG with
+a tensor-parallel strategy, allocate the per-attention-op KV caches as sharded
+device arrays, and jit ONE step function per batch-config type (incremental /
+tree-search / tree-verify — jax caches the compilation per pytree structure,
+the analogue of the reference's three task variants).  Caches are donated so
+the update is in-place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.interpreter import build_forward
+from ..core.pcg import PCG
+from .batch_config import BatchConfig, InferenceResult
+from .ops import IncMultiHeadSelfAttention
+
+
+def tensor_parallel_strategy(
+    graph, tp_axes: Tuple[str, ...] = ("tp",), mesh=None
+):
+    """Megatron-style serve strategy: attention sharded over kv-head groups,
+    MLP column→row parallel, LM head vocab-column sharded.
+
+    The analogue of the reference's default TP MachineView assignment for
+    serve graphs (``InferenceManager::compile_model_and_allocate_buffer``'s
+    tensor-parallel placement).  Unity search can replace this wholesale.
+    Dims not divisible by the TP degree are left unsharded (replicated).
+    """
+    degree = 1
+    if mesh is not None:
+        for a in tp_axes:
+            degree *= dict(mesh.shape)[a]
+
+    strategy: Dict[str, Dict] = {}
+    for node in graph.nodes:
+        t = node.op.type_name
+        op = node.op
+        if t in (
+            "inc_multihead_self_attention",
+            "spec_inc_multihead_self_attention",
+            "tree_inc_multihead_self_attention",
+        ):
+            if op.num_kv_heads % degree == 0:
+                strategy[node.name] = {"head": tp_axes}
+        elif t == "linear":
+            n = node.name
+            if "gate_proj" in n or "up_proj" in n or "fc1" in n or "c_fc" in n:
+                if op.out_dim % degree == 0:
+                    strategy[n] = {"channel_out": tp_axes}
+            elif "down_proj" in n or "fc2" in n or "c_proj" in n:
+                if op.in_dim and op.in_dim % degree == 0:
+                    strategy[n] = {"channel_in": tp_axes}
+            elif op.out_dim % degree == 0:
+                strategy[n] = {"channel_out": tp_axes}
+    return strategy
+
+
+class InferenceManager:
+    def __init__(
+        self,
+        model,
+        max_requests: int = 8,
+        max_tokens_per_batch: int = 64,
+        max_seq_len: int = 512,
+        max_spec_tokens: int = 0,
+        strategy: Optional[Dict[str, Dict]] = None,
+        tp_axes: Optional[Tuple[str, ...]] = None,
+        topk: int = 0,
+        outputs=None,
+    ):
+        """``model`` is an FFModel whose graph was built by a serve builder.
+
+        ``outputs``: the logits Tensor(s); defaults to the last node's last
+        output (the LM head) — serve graphs can have dangling intermediate
+        tensors (e.g. the unused residual sum of the final fused norm).
+        """
+        self.model = model
+        self.max_requests = max_requests
+        self.max_tokens = max_tokens_per_batch
+        self.max_seq_len = max_seq_len
+        self.max_spec_tokens = max_spec_tokens
+        self.topk = topk
+        mesh = model.mesh
+        if tp_axes is None:
+            tp_axes = ("tp",) if mesh is not None and "tp" in mesh.shape else ()
+        self.tp_axes = tuple(tp_axes)
+        if strategy is None:
+            strategy = tensor_parallel_strategy(model.graph, self.tp_axes, mesh) \
+                if self.tp_axes else {}
+        self.strategy = strategy
+        if outputs is None:
+            out_tids = [model.graph.nodes[-1].outputs[-1]]
+        else:
+            outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            out_tids = [t.tid for t in outputs]
+        self.pcg = PCG(model.graph, mesh, strategy, output_tids=out_tids)
+        self.plan = self.pcg.plan()
+        self._fwd = build_forward(self.plan, mode="spmd")
+        self._token_tid = model.graph.input_tids[0]
+        self.params = None
+        self.state = None
+        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    def init_operators_inference(self, params=None, rng=None, dtype=None):
+        """Initialize params (random if none given) and allocate KV caches.
+
+        Reference: ``InferenceManager::init_operators_inference`` +
+        the cache allocation inside each attention op's ``init_task``.
+        """
+        from ..core.interpreter import init_params
+
+        if params is None:
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+            params = init_params(self.model.graph, self.plan, rng, dtype=dtype)
+        self.params = params
+        self.state = self.allocate_kv_cache()
+        return self
+
+    def allocate_kv_cache(self):
+        mesh = self.plan.mesh
+        state: Dict[str, Any] = {}
+        for node in self.model.graph.nodes:
+            op = node.op
+            if not isinstance(op, IncMultiHeadSelfAttention):
+                continue
+            head_axes = tuple(
+                self.strategy.get(node.name, {}).get("head", ())
+            )
+            specs = op.state_specs(
+                self.max_requests,
+                self.max_seq_len,
+                self.max_spec_tokens,
+                head_axes,
+            )
+            bufs = {}
+            for name, (shape, dt, sh) in specs.items():
+                arr = jnp.zeros(shape, jnp.dtype(dt))
+                if mesh is not None and mesh.size > 1:
+                    arr = jax.device_put(arr, sh.named_sharding(mesh))
+                bufs[name] = arr
+            state[node.name] = bufs
+        return state
+
+    # ------------------------------------------------------------------
+    def _step_impl(self, params, state, bc):
+        base = bc if isinstance(bc, BatchConfig) else bc.base
+        outs, new_state = self._fwd(
+            params,
+            {self._token_tid: base.tokens},
+            state=state,
+            extras={"batch_config": bc},
+        )
+        logits = outs[0].astype(jnp.float32)  # [T, vocab]
+        token_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits_max = jnp.max(logits, axis=-1)
+        topk_ids = topk_lp = None
+        if self.topk:
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            topk_lp, topk_ids = jax.lax.top_k(lp, self.topk)
+            topk_ids = topk_ids.astype(jnp.int32)
+        return (
+            InferenceResult(token_ids, logits_max, topk_ids, topk_lp),
+            new_state,
+        )
+
+    def step(self, bc) -> InferenceResult:
+        """Run one serving step; caches update in place (donated)."""
+        assert self.params is not None, "call init_operators_inference() first"
+        result, self.state = self._step(self.params, self.state, bc)
+        return result
+
+    def reset(self):
+        """Clear all cache contents (new serving session)."""
+        self.state = self.allocate_kv_cache()

@@ -1,0 +1,100 @@
+"""Serve model zoo scaffolding: config + graph-builder registry.
+
+Reference: ``inference/models/*.cc/.h`` — each architecture is a function that
+builds the serve PCG on an ``FFModel`` from an HF-style config.  Here a
+:class:`ServeModelConfig` mirrors the HF ``config.json`` fields we need, and
+each family registers a builder keyed by HF ``model_type``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+MODEL_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(model_type: str):
+    def deco(fn):
+        MODEL_REGISTRY[model_type] = fn
+        return fn
+
+    return deco
+
+
+@dataclasses.dataclass
+class ServeModelConfig:
+    """Architecture hyperparameters (HF config.json field names)."""
+
+    model_type: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    rms_norm_eps: float = 1e-6
+    layer_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+    tie_word_embeddings: bool = False
+    # opt/mpt/starcoder-family extras
+    do_layer_norm_before: bool = True
+    parallel_attn: bool = False       # falcon: attn & mlp in parallel
+    use_alibi: bool = False           # mpt
+    new_decoder_architecture: bool = False  # falcon >= 40b
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def hdim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def from_hf_config(hf) -> "ServeModelConfig":
+        """Build from a transformers PretrainedConfig (or plain dict)."""
+        get = (lambda k, d=None: getattr(hf, k, d)) if not isinstance(hf, dict) \
+            else (lambda k, d=None: hf.get(k, d))
+        fields = {f.name for f in dataclasses.fields(ServeModelConfig)}
+        kw = {}
+        for name in fields:
+            v = get(name, None)
+            if v is not None:
+                kw[name] = v
+        # family-specific renames
+        if get("n_embd") is not None:      # starcoder/gpt_bigcode, mpt (d_model)
+            kw["hidden_size"] = get("n_embd")
+        if get("d_model") is not None:
+            kw["hidden_size"] = get("d_model")
+        if get("n_head") is not None:
+            kw["num_attention_heads"] = get("n_head")
+        if get("n_heads") is not None:
+            kw["num_attention_heads"] = get("n_heads")
+        if get("n_layer") is not None:
+            kw["num_hidden_layers"] = get("n_layer")
+        if get("n_layers") is not None:
+            kw["num_hidden_layers"] = get("n_layers")
+        if get("ffn_dim") is not None:     # opt
+            kw["intermediate_size"] = get("ffn_dim")
+        if get("n_inner") is not None and get("n_inner"):
+            kw["intermediate_size"] = get("n_inner")
+        if get("multi_query", False):      # falcon-7b / starcoder MQA
+            kw["num_key_value_heads"] = 1
+        if get("alibi", None) is not None:
+            kw["use_alibi"] = get("alibi")
+        return ServeModelConfig(**kw)
+
+
+def build_model(ff, config: ServeModelConfig, max_tokens: int):
+    """Dispatch to the registered family builder; returns the logits Tensor."""
+    if config.model_type not in MODEL_REGISTRY:
+        raise ValueError(
+            f"unknown model_type {config.model_type!r}; "
+            f"known: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[config.model_type](ff, config, max_tokens)

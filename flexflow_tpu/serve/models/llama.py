@@ -1,0 +1,67 @@
+"""LLaMA / Llama-2 serve graph builder.
+
+Reference: ``inference/models/llama.cc`` (``LLAMA::create_llama_model``) — the
+same stack expressed through the FFModel builder API: token embedding, per
+layer [fused residual RMSNorm → KV-cached GQA attention → fused residual
+RMSNorm → SwiGLU MLP], final norm, LM head.  Node names follow the HF
+state-dict layout so weight import is a direct name map.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import ServeModelConfig, register_model
+
+
+@register_model("llama")
+def build_llama(ff, cfg: ServeModelConfig, max_tokens: int):
+    tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
+    x = ff.embedding(
+        tokens, cfg.vocab_size, cfg.hidden_size, name="model.embed_tokens"
+    )
+    residual, mlp_out = x, None
+    for i in range(cfg.num_hidden_layers):
+        if i == 0:
+            attn_in = ff.rms_norm(
+                residual, eps=cfg.rms_norm_eps,
+                name=f"model.layers.{i}.input_layernorm",
+            )
+        else:
+            residual, attn_in = ff.residual_rms_norm(
+                mlp_out, residual, eps=cfg.rms_norm_eps,
+                name=f"model.layers.{i}.input_layernorm",
+            )
+        attn = ff.inc_multihead_self_attention(
+            attn_in,
+            cfg.hidden_size,
+            cfg.num_attention_heads,
+            cfg.kv_heads,
+            cfg.hdim,
+            rotary_embedding=True,
+            rope_theta=cfg.rope_theta,
+            use_bias=False,
+            name=f"model.layers.{i}.self_attn",
+        )
+        residual, mlp_in = ff.residual_rms_norm(
+            attn, residual, eps=cfg.rms_norm_eps,
+            name=f"model.layers.{i}.post_attention_layernorm",
+        )
+        gate = ff.dense(
+            mlp_in, cfg.intermediate_size, use_bias=False,
+            name=f"model.layers.{i}.mlp.gate_proj",
+        )
+        up = ff.dense(
+            mlp_in, cfg.intermediate_size, use_bias=False,
+            name=f"model.layers.{i}.mlp.up_proj",
+        )
+        act = ff.sigmoid_silu_multi(gate, up, name=f"model.layers.{i}.mlp.act")
+        mlp_out = ff.dense(
+            act, cfg.hidden_size, use_bias=False,
+            name=f"model.layers.{i}.mlp.down_proj",
+        )
+    _, normed = ff.residual_rms_norm(
+        mlp_out, residual, eps=cfg.rms_norm_eps, name="model.norm"
+    )
+    logits = ff.dense(normed, cfg.vocab_size, use_bias=False, name="lm_head")
+    return logits

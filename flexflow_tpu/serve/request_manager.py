@@ -1,0 +1,208 @@
+"""RequestManager: request queue, continuous batching, decode orchestration.
+
+Reference: ``src/runtime/request_manager.cc`` — ``register_new_request``,
+``prepare_next_batch`` (admit/retire requests, mix prompt-prefill chunks with
+single decode tokens in one flat token batch), ``serve_incr_decoding``; the
+speculative path (``prepare_next_batch_beam/_verify``, ``serve_spec_infer``)
+lives in :mod:`flexflow_tpu.serve.spec_infer` and reuses this class.
+
+Host-side Python is the right tool here (the reference uses host-side C++):
+the per-step compute is one jitted TPU program; this class only does queue
+bookkeeping and builds the next fixed-capacity BatchConfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batch_config import BatchConfig
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 0
+    PREFILLING = 1
+    DECODING = 2
+    COMPLETED = 3
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int = 64
+    status: RequestStatus = RequestStatus.PENDING
+    generated: List[int] = dataclasses.field(default_factory=list)
+    prefill_offset: int = 0     # prompt tokens already fed to the model
+    slot: int = -1
+
+    @property
+    def seq_len(self) -> int:
+        """Tokens currently in the KV cache (after the last step)."""
+        return self.prefill_offset + len(self.generated)
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    max_new_tokens: int = 64
+    eos_token_id: Optional[int] = None
+    stop_on_eos: bool = True
+
+
+class RequestManager:
+    def __init__(self, im, gen_config: Optional[GenerationConfig] = None):
+        self.im = im
+        self.gen = gen_config or GenerationConfig()
+        self.requests: Dict[int, Request] = {}
+        self.pending: List[int] = []
+        self.slots: List[Optional[int]] = [None] * im.max_requests
+        self._next_rid = 0
+        self.steps = 0
+        self.tokens_decoded = 0
+
+    # ------------------------------------------------------------------
+    def register_new_request(
+        self, prompt_tokens: Sequence[int], max_new_tokens: Optional[int] = None
+    ) -> int:
+        if not len(prompt_tokens):
+            raise ValueError("empty prompt")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(
+            rid,
+            list(int(t) for t in prompt_tokens),
+            self.gen.max_new_tokens if max_new_tokens is None else max_new_tokens,
+        )
+        if len(req.prompt) + req.max_new_tokens > self.im.max_seq_len:
+            raise ValueError(
+                f"request length {len(req.prompt)}+{req.max_new_tokens} "
+                f"exceeds max_seq_len {self.im.max_seq_len}"
+            )
+        self.requests[rid] = req
+        self.pending.append(rid)
+        return rid
+
+    def _admit(self):
+        for i, occupant in enumerate(self.slots):
+            if occupant is None and self.pending:
+                rid = self.pending.pop(0)
+                req = self.requests[rid]
+                req.slot = i
+                req.status = RequestStatus.PREFILLING
+                self.slots[i] = rid
+
+    def _active(self) -> List[Request]:
+        return [
+            self.requests[rid] for rid in self.slots if rid is not None
+        ]
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or any(
+            r.status in (RequestStatus.PREFILLING, RequestStatus.DECODING)
+            for r in self._active()
+        )
+
+    # ------------------------------------------------------------------
+    def prepare_next_batch(self) -> Tuple[BatchConfig, List[Tuple[int, int]]]:
+        """Build the next step's BatchConfig.
+
+        Returns (bc, sample_points) where sample_points is
+        ``[(flat_token_index, rid)]`` — the token slots whose model output is
+        the next token of that request (last prefill token, or the decode
+        token).  Mirrors ``RequestManager::prepare_next_batch``.
+        """
+        self._admit()
+        tokens: List[int] = []
+        req_idx: List[int] = []
+        positions: List[int] = []
+        sample_points: List[Tuple[int, int]] = []
+        budget = self.im.max_tokens
+
+        # decode tokens first: one per DECODING request (latency-critical)
+        for req in self._active():
+            if req.status is RequestStatus.DECODING and budget > 0:
+                pos = req.seq_len - 1
+                tokens.append(req.generated[-1])
+                req_idx.append(req.slot)
+                positions.append(pos)
+                sample_points.append((len(tokens) - 1, req.rid))
+                budget -= 1
+
+        # then prefill chunks fill the remaining budget
+        for req in self._active():
+            if req.status is not RequestStatus.PREFILLING or budget <= 0:
+                continue
+            take = min(budget, len(req.prompt) - req.prefill_offset)
+            start = req.prefill_offset
+            for j in range(take):
+                tokens.append(req.prompt[start + j])
+                req_idx.append(req.slot)
+                positions.append(start + j)
+            req.prefill_offset += take
+            budget -= take
+            if req.prefill_offset == len(req.prompt):
+                # output at the last prompt token = first generated token
+                sample_points.append((len(tokens) - 1, req.rid))
+
+        # cache depth after this step: prompt tokens fed so far + generated
+        # tokens (the decode token fed this step is generated[-1], whose KV
+        # lands at position seq_len-1 during the step)
+        seq_lens = np.zeros(self.im.max_requests, np.int32)
+        for req in self._active():
+            seq_lens[req.slot] = req.prefill_offset + len(req.generated)
+        bc = BatchConfig.build(
+            tokens, req_idx, positions, seq_lens,
+            max_tokens=self.im.max_tokens,
+            max_requests=self.im.max_requests,
+        )
+        return bc, sample_points
+
+    def process_result(self, result, sample_points) -> None:
+        token_ids = np.asarray(result.token_ids)
+        for flat_idx, rid in sample_points:
+            req = self.requests[rid]
+            tok = int(token_ids[flat_idx])
+            if req.status is RequestStatus.PREFILLING:
+                req.status = RequestStatus.DECODING
+            req.generated.append(tok)
+            self.tokens_decoded += 1
+            self._maybe_finish(req)
+
+    def _maybe_finish(self, req: Request) -> None:
+        eos = self.gen.eos_token_id
+        if (
+            len(req.generated) >= req.max_new_tokens
+            or (self.gen.stop_on_eos and eos is not None
+                and req.generated and req.generated[-1] == eos)
+        ):
+            req.status = RequestStatus.COMPLETED
+            if req.slot >= 0:
+                self.slots[req.slot] = None
+                req.slot = -1
+
+    # ------------------------------------------------------------------
+    def serve_incr_decoding(self) -> Dict[int, List[int]]:
+        """Run the incremental-decoding loop until all requests complete.
+
+        Reference: ``RequestManager::serve_incr_decoding``.
+        """
+        while self.has_work():
+            bc, sample_points = self.prepare_next_batch()
+            result = self.im.step(bc)
+            self.process_result(result, sample_points)
+            self.steps += 1
+        return {rid: r.generated for rid, r in self.requests.items()}
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+    ) -> List[List[int]]:
+        rids = [
+            self.register_new_request(p, max_new_tokens) for p in prompts
+        ]
+        out = self.serve_incr_decoding()
+        return [out[rid] for rid in rids]
