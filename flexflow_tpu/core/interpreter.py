@@ -117,7 +117,12 @@ def build_forward(plan: Plan, mode: str = "spmd") -> Callable:
     }
     out_pspecs = [sh.partition_spec() for sh in plan.output_shardings]
 
-    def fn(params, inputs, rng=None, training=False):
+    def fn(params, inputs, rng=None, training=False, state=None, extras=None):
+        if state is not None or extras is not None:
+            raise NotImplementedError(
+                "stateful execution (serve) is only supported in spmd mode; "
+                "local/shard_map mode would need state pspecs threaded through"
+            )
         # params not listed in the plan (unused nodes) are passed replicated
         pspecs = {
             name: param_pspecs.get(

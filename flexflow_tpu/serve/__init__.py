@@ -26,6 +26,7 @@ from .request_manager import (
     RequestManager,
     RequestStatus,
 )
+from .spec_infer import SpecInferManager
 
 from . import models  # noqa: F401  (registers model builders)
 
@@ -40,6 +41,7 @@ __all__ = [
     "Request",
     "RequestStatus",
     "GenerationConfig",
+    "SpecInferManager",
     "ServeModelConfig",
     "build_model",
     "MODEL_REGISTRY",

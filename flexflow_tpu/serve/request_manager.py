@@ -53,6 +53,8 @@ class GenerationConfig:
 
 
 class RequestManager:
+    request_cls = Request  # subclasses (SpecInferManager) extend the record
+
     def __init__(self, im, gen_config: Optional[GenerationConfig] = None):
         self.im = im
         self.gen = gen_config or GenerationConfig()
@@ -64,6 +66,10 @@ class RequestManager:
         self.tokens_decoded = 0
 
     # ------------------------------------------------------------------
+    def _seq_len_needed(self, req: Request) -> int:
+        """Cache depth a request may reach (overridden by speculation)."""
+        return len(req.prompt) + req.max_new_tokens
+
     def register_new_request(
         self, prompt_tokens: Sequence[int], max_new_tokens: Optional[int] = None
     ) -> int:
@@ -71,14 +77,14 @@ class RequestManager:
             raise ValueError("empty prompt")
         rid = self._next_rid
         self._next_rid += 1
-        req = Request(
+        req = self.request_cls(
             rid,
             list(int(t) for t in prompt_tokens),
             self.gen.max_new_tokens if max_new_tokens is None else max_new_tokens,
         )
-        if len(req.prompt) + req.max_new_tokens > self.im.max_seq_len:
+        if self._seq_len_needed(req) > self.im.max_seq_len:
             raise ValueError(
-                f"request length {len(req.prompt)}+{req.max_new_tokens} "
+                f"request needs {self._seq_len_needed(req)} cache slots, "
                 f"exceeds max_seq_len {self.im.max_seq_len}"
             )
         self.requests[rid] = req
@@ -196,6 +202,8 @@ class RequestManager:
             self.steps += 1
         return {rid: r.generated for rid, r in self.requests.items()}
 
+    _serve = serve_incr_decoding  # overridden by SpecInferManager
+
     def generate(
         self,
         prompts: Sequence[Sequence[int]],
@@ -204,5 +212,5 @@ class RequestManager:
         rids = [
             self.register_new_request(p, max_new_tokens) for p in prompts
         ]
-        out = self.serve_incr_decoding()
+        out = self._serve()
         return [out[rid] for rid in rids]

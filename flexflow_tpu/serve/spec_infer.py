@@ -1,0 +1,383 @@
+"""SpecInfer: tree-based speculative decoding (SSM draft + LLM verify).
+
+Reference: ``RequestManager::serve_spec_infer`` / ``prepare_next_batch_beam`` /
+``prepare_next_batch_verify`` in ``src/runtime/request_manager.cc`` and the
+SpecInfer ASPLOS'24 design: a small draft model (SSM) expands a token TREE per
+request; the LLM verifies the whole tree in ONE batched step using
+tree-topology causal attention; the longest root-path whose tokens match the
+LLM's own greedy choices is committed, plus one "bonus" token from the LLM —
+so each LLM pass can commit up to depth+1 tokens.
+
+Per macro-step, per request (host bookkeeping; device work is 4 jitted
+programs total — SSM inc/tree-search, LLM inc/tree-verify):
+
+1. *catch-up*   — feed tokens accepted last round into the SSM's committed
+   cache (plain ``BatchConfig``; the LLM's copies are committed via the
+   verify step's commit descriptor instead, reusing KV computed during
+   verification).
+2. *draft*      — root = latest token; ``depth`` beam-expansion steps of
+   width ``width`` through the SSM (``TreeSearchBatchConfig``), keeping
+   per-node cumulative logprobs; nodes live in the spec KV buffer.
+3. *verify*     — flatten the tree into one ``TreeVerifyBatchConfig`` step of
+   the LLM (commit descriptor carries last round's accepted nodes); walk the
+   result greedily root-down to find the accepted path + bonus token.
+
+Greedy invariant (tested): output sequences are EXACTLY those of plain
+incremental decoding with the LLM, for any draft model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batch_config import (
+    BatchConfig,
+    TreeSearchBatchConfig,
+    TreeVerifyBatchConfig,
+)
+from .inference_manager import InferenceManager
+from .request_manager import (
+    GenerationConfig,
+    Request,
+    RequestManager,
+    RequestStatus,
+)
+
+
+@dataclasses.dataclass
+class TokenTreeNode:
+    token: int
+    parent: int          # index into the tree's node list (-1 for root)
+    depth: int
+    logprob: float = 0.0  # cumulative draft logprob along the root path
+
+
+@dataclasses.dataclass
+class SpecRequest(Request):
+    """Request + speculation bookkeeping."""
+
+    # accepted-but-not-yet-committed (spec_index, position, token) triples;
+    # committed into the LLM cache by the NEXT verify step's commit descriptor
+    pending_commit: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    llm_committed: int = 0   # LLM cache depth
+    ssm_committed: int = 0   # SSM cache depth
+    ssm_backlog: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    tree: List[TokenTreeNode] = dataclasses.field(default_factory=list)
+
+
+class SpecInferManager(RequestManager):
+    """Drives speculative serving over two InferenceManagers (SSM + LLM).
+
+    Queue/admission/stopping logic is inherited from :class:`RequestManager`
+    (so incremental and speculative serving can never diverge on lifecycle
+    semantics); this class replaces the per-step loop with the three-phase
+    macro step.  ``width``/``depth`` bound each request's tree to
+    ``1 + width*depth`` nodes; all capacities are validated up front.
+    """
+
+    request_cls = SpecRequest
+
+    def __init__(
+        self,
+        llm: InferenceManager,
+        ssm: InferenceManager,
+        gen_config: Optional[GenerationConfig] = None,
+        width: int = 2,
+        depth: int = 3,
+    ):
+        super().__init__(llm, gen_config)
+        self.llm = llm
+        self.ssm = ssm
+        self.width = width
+        self.depth = depth
+        self.max_tree = 1 + width * depth
+        if llm.max_spec_tokens < self.max_tree or ssm.max_spec_tokens < self.max_tree:
+            raise ValueError(
+                f"spec buffers too small: need {self.max_tree} slots, have "
+                f"llm={llm.max_spec_tokens} ssm={ssm.max_spec_tokens}"
+            )
+        if llm.max_requests != ssm.max_requests:
+            raise ValueError("LLM and SSM must agree on max_requests")
+        if llm.max_tokens < llm.max_requests * self.max_tree:
+            raise ValueError(
+                "LLM max_tokens_per_batch must fit max_requests full trees "
+                f"({llm.max_requests}x{self.max_tree})"
+            )
+        if ssm.max_tokens < ssm.max_requests * width:
+            raise ValueError(
+                "SSM max_tokens_per_batch must fit one frontier per request "
+                f"({ssm.max_requests}x{width})"
+            )
+        if ssm.topk < width:
+            raise ValueError(f"SSM InferenceManager needs topk >= width ({width})")
+        self.macro_steps = 0
+        self.llm_steps = 0
+
+    def _seq_len_needed(self, req: Request) -> int:
+        # verification scores up to `depth` speculative positions past the
+        # last committed token, so the cache needs headroom beyond max_new
+        return len(req.prompt) + req.max_new_tokens + self.depth + 1
+
+    # ------------------------------------------------------------------
+    # phase A: prompt prefill (both models) + SSM catch-up
+    # ------------------------------------------------------------------
+    def _prefill_phase(self):
+        self._admit()
+        # LLM prefill for new requests (chunked by the LLM token budget)
+        while True:
+            toks, reqi, pos, points = [], [], [], []
+            budget = self.llm.max_tokens
+            for req in self._active():
+                if req.status is not RequestStatus.PREFILLING or budget <= 0:
+                    continue
+                take = min(budget, len(req.prompt) - req.prefill_offset)
+                st = req.prefill_offset
+                toks += req.prompt[st : st + take]
+                reqi += [req.slot] * take
+                pos += list(range(st, st + take))
+                req.prefill_offset += take
+                budget -= take
+                if req.prefill_offset == len(req.prompt):
+                    points.append((len(toks) - 1, req.rid))
+            if not toks:
+                break
+            bc = self._plain_bc(self.llm, toks, reqi, pos)
+            result = self.llm.step(bc)
+            self.llm_steps += 1
+            ids = np.asarray(result.token_ids)
+            for flat, rid in points:
+                req = self.requests[rid]
+                req.status = RequestStatus.DECODING
+                req.llm_committed = len(req.prompt)
+                req.generated.append(int(ids[flat]))
+                self.tokens_decoded += 1
+                self._maybe_finish(req)
+
+        # SSM prefill (prompt) + catch-up (tokens accepted by previous rounds)
+        while True:
+            toks, reqi, pos = [], [], []
+            budget = self.ssm.max_tokens
+            for req in self._active():
+                if budget <= 0:
+                    break
+                if req.ssm_committed < len(req.prompt):
+                    take = min(budget, len(req.prompt) - req.ssm_committed)
+                    st = req.ssm_committed
+                    toks += req.prompt[st : st + take]
+                    reqi += [req.slot] * take
+                    pos += list(range(st, st + take))
+                    req.ssm_committed += take
+                    budget -= take
+                if req.ssm_backlog and budget > 0:
+                    take = min(budget, len(req.ssm_backlog))
+                    for t, p in req.ssm_backlog[:take]:
+                        toks.append(t)
+                        reqi.append(req.slot)
+                        pos.append(p)
+                    req.ssm_backlog = req.ssm_backlog[take:]
+                    req.ssm_committed += take
+                    budget -= take
+            if not toks:
+                break
+            self.ssm.step(self._plain_bc(self.ssm, toks, reqi, pos))
+
+    def _plain_bc(self, im, toks, reqi, pos):
+        seq_lens = np.zeros(im.max_requests, np.int32)
+        for req in self._active():
+            seq_lens[req.slot] = req.seq_len
+        return BatchConfig.build(
+            toks, reqi, pos, seq_lens,
+            max_tokens=im.max_tokens, max_requests=im.max_requests,
+        )
+
+    # ------------------------------------------------------------------
+    # phase B: draft-tree expansion through the SSM
+    # ------------------------------------------------------------------
+    def _draft_phase(self) -> List[SpecRequest]:
+        drafting = [r for r in self._active() if r.status is RequestStatus.DECODING]
+        if not drafting:
+            return []
+        P = self.ssm.max_spec_tokens
+        R = self.ssm.max_requests
+        masks = np.zeros((R, P, P), bool)
+        for req in drafting:
+            req.tree = [TokenTreeNode(req.generated[-1], -1, 0, 0.0)]
+            masks[req.slot, 0, 0] = True
+
+        frontier = {req.rid: [0] for req in drafting}  # node indices at depth d
+        # feeding depth-d nodes yields depth-(d+1) children; final-depth nodes
+        # are never fed (their KV is only needed by the LLM's verify pass)
+        for d in range(self.depth):
+            toks, reqi, pos, spec, points = [], [], [], [], []
+            for req in drafting:
+                for ni in frontier.get(req.rid, []):
+                    node = req.tree[ni]
+                    toks.append(node.token)
+                    reqi.append(req.slot)
+                    pos.append(req.llm_committed + node.depth)
+                    spec.append(ni)
+                    points.append((len(toks) - 1, req.rid, ni))
+            if not toks:
+                break
+            bc = self._tree_bc(
+                TreeSearchBatchConfig, self.ssm, toks, reqi, pos, spec, masks,
+                committed_attr="ssm_committed",
+            )
+            result = self.ssm.step(bc)
+            topk_ids = np.asarray(result.topk_ids)
+            topk_lp = np.asarray(result.topk_logprobs)
+            # beam-select the next frontier per request
+            for req in drafting:
+                cands = []
+                for flat, rid, ni in points:
+                    if rid != req.rid:
+                        continue
+                    base_lp = req.tree[ni].logprob
+                    for j in range(self.width):
+                        cands.append(
+                            (base_lp + float(topk_lp[flat, j]),
+                             int(topk_ids[flat, j]), ni)
+                        )
+                cands.sort(reverse=True)
+                nxt = []
+                for lp, tok, parent in cands[: self.width]:
+                    if len(req.tree) >= self.max_tree:
+                        break
+                    idx = len(req.tree)
+                    req.tree.append(
+                        TokenTreeNode(tok, parent, req.tree[parent].depth + 1, lp)
+                    )
+                    # ancestor mask row = parent's row + self
+                    masks[req.slot, idx] = masks[req.slot, parent]
+                    masks[req.slot, idx, idx] = True
+                    nxt.append(idx)
+                frontier[req.rid] = nxt
+        return drafting
+
+    def _tree_bc(self, cls, im, toks, reqi, pos, spec, masks, committed_attr,
+                 commit=None):
+        seq_lens = np.zeros(im.max_requests, np.int32)
+        committed = np.zeros(im.max_requests, np.int32)
+        for req in self._active():
+            seq_lens[req.slot] = req.seq_len
+            committed[req.slot] = getattr(req, committed_attr)
+        base = BatchConfig.build(
+            toks, reqi, pos, seq_lens,
+            max_tokens=im.max_tokens, max_requests=im.max_requests,
+        )
+        import jax.numpy as jnp
+
+        P = im.max_spec_tokens
+        si = np.zeros(im.max_tokens, np.int32)
+        si[: len(spec)] = spec
+        kw = dict(
+            base=base,
+            spec_index=jnp.asarray(si),
+            ancestor_mask=jnp.asarray(masks[:, :P, :P]),
+            committed_lens=jnp.asarray(committed),
+        )
+        if cls is TreeVerifyBatchConfig:
+            n = im.max_tokens
+            cri = np.full(n, -1, np.int32)
+            csi = np.zeros(n, np.int32)
+            cdp = np.zeros(n, np.int32)
+            commit = commit or []
+            for i, (slot, src, dst) in enumerate(commit):
+                cri[i], csi[i], cdp[i] = slot, src, dst
+            kw.update(
+                commit_request_index=jnp.asarray(cri),
+                commit_src_spec_index=jnp.asarray(csi),
+                commit_dst_position=jnp.asarray(cdp),
+            )
+        return cls(**kw)
+
+    # ------------------------------------------------------------------
+    # phase C: LLM tree verification + accept walk
+    # ------------------------------------------------------------------
+    def _verify_phase(self, drafting: List[SpecRequest]):
+        if not drafting:
+            return
+        R = self.llm.max_requests
+        P = self.llm.max_spec_tokens
+        masks = np.zeros((R, P, P), bool)
+        toks, reqi, pos, spec, index_of = [], [], [], [], {}
+        commit = []
+        for req in drafting:
+            for ni, node in enumerate(req.tree):
+                masks[req.slot, ni, ni] = True
+                if node.parent >= 0:
+                    masks[req.slot, ni] |= masks[req.slot, node.parent]
+                    masks[req.slot, ni, ni] = True
+                index_of[(req.rid, ni)] = len(toks)
+                toks.append(node.token)
+                reqi.append(req.slot)
+                pos.append(req.llm_committed + node.depth)
+                spec.append(ni)
+            for src, dst in req.pending_commit:
+                commit.append((req.slot, src, dst))
+            req.pending_commit = []
+        bc = self._tree_bc(
+            TreeVerifyBatchConfig, self.llm, toks, reqi, pos, spec, masks,
+            committed_attr="llm_committed", commit=commit,
+        )
+        result = self.llm.step(bc)
+        self.llm_steps += 1
+        ids = np.asarray(result.token_ids)
+
+        for req in drafting:
+            # greedy accept walk from the root
+            ni = 0
+            accepted_nodes = [0]
+            while True:
+                want = int(ids[index_of[(req.rid, ni)]])
+                child = next(
+                    (
+                        j
+                        for j, n in enumerate(req.tree)
+                        if n.parent == ni and n.token == want
+                    ),
+                    None,
+                )
+                if child is None:
+                    bonus = want
+                    break
+                accepted_nodes.append(child)
+                ni = child
+            # commit root + accepted draft nodes next round; emit their tokens
+            new_tokens = []
+            for k, node_idx in enumerate(accepted_nodes):
+                node = req.tree[node_idx]
+                posn = req.llm_committed + node.depth
+                req.pending_commit.append((node_idx, posn))
+                if k > 0:  # root token was already in req.generated
+                    new_tokens.append(node.token)
+            new_tokens.append(bonus)
+            req.llm_committed += len(accepted_nodes)
+            # SSM needs the same accepted tokens in its committed cache; the
+            # root (generated[-1] pre-walk) is part of them
+            base_pos = req.ssm_committed
+            acc_toks = [req.tree[i].token for i in accepted_nodes]
+            req.ssm_backlog += [
+                (t, base_pos + k) for k, t in enumerate(acc_toks)
+            ]
+            for t in new_tokens:
+                req.generated.append(t)
+                self.tokens_decoded += 1
+                self._maybe_finish(req)
+                if req.status is RequestStatus.COMPLETED:
+                    break
+
+    # ------------------------------------------------------------------
+    def serve_spec_infer(self) -> Dict[int, List[int]]:
+        """Reference: ``RequestManager::serve_spec_infer``."""
+        while self.has_work():
+            self._prefill_phase()
+            drafting = self._draft_phase()
+            self._verify_phase(drafting)
+            self.macro_steps += 1
+        return {rid: r.generated for rid, r in self.requests.items()}
+
+    _serve = serve_spec_infer

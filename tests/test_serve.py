@@ -37,7 +37,7 @@ TINY = ServeModelConfig(
 
 
 def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
-            max_spec=0, cfg=TINY):
+            max_spec=0, cfg=TINY, topk=0, seed=7):
     axes = mesh_axes or {"tp": 1}
     n = int(np.prod(list(axes.values())))
     mesh = make_mesh(axes, jax.devices()[:n])
@@ -45,9 +45,9 @@ def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
     build_model(ff, cfg, max_tokens)
     im = InferenceManager(
         ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
-        max_seq_len=max_seq, max_spec_tokens=max_spec,
+        max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
     )
-    im.init_operators_inference(rng=jax.random.PRNGKey(7))
+    im.init_operators_inference(rng=jax.random.PRNGKey(seed))
     return im
 
 

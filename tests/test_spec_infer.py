@@ -1,0 +1,98 @@
+"""SpecInfer tests.
+
+The hard gate (SURVEY.md §4, reference ``tests/inference``): speculative
+decoding must produce EXACTLY the same output sequences as plain incremental
+decoding — for ANY draft model (bad drafts only cost speed, never change
+output) — and a perfect draft (SSM == LLM) must commit multiple tokens per
+LLM pass (the speedup lever).
+"""
+
+import jax
+import pytest
+
+from flexflow_tpu.serve import (
+    GenerationConfig,
+    RequestManager,
+    ServeModelConfig,
+    SpecInferManager,
+)
+
+from test_serve import TINY, make_im
+
+TINY_SSM = ServeModelConfig(
+    model_type="llama",
+    vocab_size=TINY.vocab_size,  # must share the vocab
+    hidden_size=16,
+    intermediate_size=32,
+    num_hidden_layers=1,
+    num_attention_heads=2,
+    num_key_value_heads=2,
+)
+
+PROMPTS = [[3, 11, 25, 40, 7], [2, 4, 6, 8], [33, 1, 60]]
+
+
+def incr_outputs(n_new=10, prompts=PROMPTS):
+    im = make_im(max_tokens=32, max_requests=2, max_seq=64)
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=n_new))
+    return rm.generate(prompts)
+
+
+@pytest.mark.parametrize("width,depth", [(1, 3), (2, 2), (2, 3)])
+def test_spec_matches_incremental(width, depth):
+    want = incr_outputs()
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(
+        max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+        cfg=TINY_SSM, topk=max(width, 1), seed=123,
+    )
+    sm = SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=10), width=width, depth=depth
+    )
+    got = sm.generate(PROMPTS)
+    assert got == want, f"spec(w={width},d={depth}) {got} != incr {want}"
+
+
+def test_perfect_draft_accelerates():
+    # SSM == LLM (identical params): every chain drafts perfectly, so each
+    # LLM pass commits depth+1 tokens; verify the step-count accounting.
+    n_new = 12
+    want = incr_outputs(n_new, prompts=[PROMPTS[0]])
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(
+        max_tokens=32, max_requests=2, max_seq=64, max_spec=8, topk=1
+    )
+    sm = SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=n_new), width=1, depth=3
+    )
+    got = sm.generate([PROMPTS[0]])
+    assert got == want
+    # 1 prefill step + ceil((12-1)/(3+1)) verify steps = 4 verify steps
+    assert sm.llm_steps <= 1 + 3 + 1, (
+        f"perfect draft should need ~{1 + 3} LLM passes for {n_new} tokens, "
+        f"took {sm.llm_steps}"
+    )
+
+
+def test_spec_with_eos():
+    want = incr_outputs()
+    eos = want[0][2]  # third token of request 0
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(
+        max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+        cfg=TINY_SSM, topk=2, seed=123,
+    )
+    sm = SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=10, eos_token_id=eos),
+        width=2, depth=3,
+    )
+    got = sm.generate([PROMPTS[0]])[0]
+    assert got == want[0][: want[0].index(eos) + 1]
+
+
+def test_capacity_validation():
+    llm = make_im(max_tokens=16, max_requests=2, max_seq=64, max_spec=4)
+    ssm = make_im(max_tokens=16, max_requests=2, max_seq=64, max_spec=4,
+                  cfg=TINY_SSM, topk=2, seed=1)
+    with pytest.raises(ValueError):  # tree 1+2*3=7 > spec buffer 4
+        SpecInferManager(llm, ssm, width=2, depth=3)
