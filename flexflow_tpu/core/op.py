@@ -148,6 +148,23 @@ class Op:
         return f"{type(self).__name__}"
 
 
+def bias_once(bias: jax.Array, axes, ctx: OpContext) -> jax.Array:
+    """Zero a bias on all but one shard when the op output is a partial sum.
+
+    When an op's output is partial over mesh ``axes`` (row-parallel linear,
+    TP attention out-proj, vocab-sharded embedding), the bias must be counted
+    exactly once by the later reduction.  In spmd mode arrays are global and
+    GSPMD's own all-reduce already yields the true sum, so the bias is added
+    as-is; only local/shard_map mode needs the one-shard trick.
+    """
+    if axes and ctx.mode == "local" and ctx.mesh is not None:
+        idx = jnp.int32(0)
+        for a in axes:
+            idx = idx + jax.lax.axis_index(a)
+        return jnp.where(idx == 0, bias, jnp.zeros_like(bias))
+    return bias
+
+
 # ---------------------------------------------------------------------------
 # registry (op type name -> class), for strategy/serialization round-trips
 # ---------------------------------------------------------------------------

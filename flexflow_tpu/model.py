@@ -264,13 +264,19 @@ class FFModel:
                                      num_kv_heads=None, head_dim=None,
                                      rotary_embedding=True, rope_theta=10000.0,
                                      use_bias=False, scaling_factor=None,
-                                     name=None):
+                                     use_alibi=False, name=None):
         from .serve.ops import IncMultiHeadSelfAttention
 
         op = IncMultiHeadSelfAttention(
             embed_dim, num_q_heads, num_kv_heads, head_dim, rotary_embedding,
-            rope_theta, use_bias, scaling_factor, dtype=x.dtype)
+            rope_theta, use_bias, scaling_factor, use_alibi, dtype=x.dtype)
         return self._add(op, [x], name or "inc_mha")[0]
+
+    def position_embedding(self, x, num_positions, offset=0, name=None):
+        from .serve.ops import PositionEmbedding
+
+        op = PositionEmbedding(num_positions, x.shape[-1], offset, x.dtype)
+        return self._add(op, [x], name or "position_embedding")[0]
 
     def spec_inc_multihead_self_attention(self, x, embed_dim, num_q_heads,
                                           num_kv_heads=None, head_dim=None,

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.graph import ParamSpec, TensorSpec
-from ..core.op import Op, ShardingSolution, register_op
+from ..core.op import Op, ShardingSolution, bias_once, register_op
 from ..core.sharding import TensorSharding
 
 
@@ -98,15 +98,9 @@ class MultiHeadAttention(Op):
                            preferred_element_type=acc)
         out = jnp.einsum("bqhd,hde->bqe", ctx_v, params["wo"],
                          preferred_element_type=acc)
-        partial_heads = bool(ctx.config and ctx.config.get("head"))
         if self.use_bias:
-            bo = params["bo"]
-            if partial_heads and ctx.mode == "local" and ctx.mesh is not None:
-                idx = jnp.int32(0)
-                for a in ctx.config["head"]:
-                    idx = idx + jax.lax.axis_index(a)
-                bo = jnp.where(idx == 0, bo, jnp.zeros_like(bo))
-            out = out + bo
+            head = tuple(ctx.config.get("head", ())) if ctx.config else ()
+            out = out + bias_once(params["bo"], head, ctx)
         return [out.astype(self.dtype)]
 
     def parallel_dims(self, in_specs):

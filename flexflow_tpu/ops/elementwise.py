@@ -26,7 +26,8 @@ from ..core.sharding import TensorSharding
 
 UNARY_FNS = {
     "relu": lambda x: jnp.maximum(x, 0),
-    "gelu": jax.nn.gelu,
+    "gelu": jax.nn.gelu,  # tanh approximation (HF "gelu_pytorch_tanh")
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
     "sigmoid": jax.nn.sigmoid,
     "tanh": jnp.tanh,
     "exp": jnp.exp,

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.graph import ParamSpec, TensorSpec
-from ..core.op import Op, OpContext, ShardingSolution, register_op
+from ..core.op import Op, OpContext, ShardingSolution, bias_once, register_op
 from ..core.sharding import TensorSharding
 from .elementwise import UNARY_FNS, propagate
 
@@ -88,15 +88,8 @@ class Linear(Op):
         y = jnp.dot(x, kernel, preferred_element_type=_acc_dtype(x.dtype))
         partial_in = bool(ctx.config and ctx.config.get("channel_in"))
         if self.use_bias:
-            bias = params["bias"]
-            if partial_in and ctx.mode == "local" and ctx.mesh is not None:
-                # output is a partial sum over channel_in axes: add the bias on
-                # exactly one shard so the later reduction counts it once
-                idx = jnp.int32(0)
-                for a in ctx.config["channel_in"]:
-                    idx = idx + jax.lax.axis_index(a)
-                bias = jnp.where(idx == 0, bias, jnp.zeros_like(bias))
-            y = y + bias
+            c_in = tuple(ctx.config.get("channel_in", ())) if ctx.config else ()
+            y = y + bias_once(params["bias"], c_in, ctx)
         if self.activation is not None and not partial_in:
             y = UNARY_FNS[self.activation](y)
         return [y.astype(self.dtype)]

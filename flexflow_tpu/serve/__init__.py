@@ -27,6 +27,8 @@ from .request_manager import (
     RequestStatus,
 )
 from .spec_infer import SpecInferManager
+from .api import LLM, SSM
+from .weights import convert_state_dict, load_hf_model, place_params
 
 from . import models  # noqa: F401  (registers model builders)
 
@@ -42,6 +44,11 @@ __all__ = [
     "RequestStatus",
     "GenerationConfig",
     "SpecInferManager",
+    "LLM",
+    "SSM",
+    "convert_state_dict",
+    "load_hf_model",
+    "place_params",
     "ServeModelConfig",
     "build_model",
     "MODEL_REGISTRY",

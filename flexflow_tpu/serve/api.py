@@ -1,0 +1,147 @@
+"""User-facing serving API: the ``LLM`` / ``SSM`` classes.
+
+Reference: ``python/flexflow/serve/__init__.py`` + ``serve/serve.py`` — the
+``LLM(model_name).compile(...); llm.generate(prompts)`` flow, with an optional
+list of SSMs enabling SpecInfer.  Here weights come from a local HF checkpoint
+(or an in-memory transformers model / raw state dict); the tokenizer is the HF
+tokenizer when available, otherwise prompts are token-id lists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..parallel.mesh import make_mesh
+from .inference_manager import InferenceManager
+from .models.base import ServeModelConfig, build_model
+from .request_manager import GenerationConfig, RequestManager
+from .spec_infer import SpecInferManager
+from .weights import convert_state_dict, load_hf_model, place_params
+
+
+class LLM:
+    def __init__(
+        self,
+        model: Any,
+        tokenizer: Any = None,
+        config: Optional[ServeModelConfig] = None,
+    ):
+        """``model``: local HF checkpoint path, a transformers model instance,
+        a raw HF state dict (requires ``config``), or a ServeModelConfig for
+        random-weight serving."""
+        self.tokenizer = tokenizer
+        self._sd = None
+        if isinstance(model, str):
+            self._sd, self.config, tok = load_hf_model(model)
+            self.tokenizer = tokenizer or tok
+        elif isinstance(model, ServeModelConfig):
+            self.config = model
+        elif isinstance(model, dict):
+            if config is None:
+                raise ValueError("raw state dict needs an explicit config")
+            self._sd, self.config = model, config
+        else:  # transformers PreTrainedModel
+            self._sd = model.state_dict()
+            self.config = config or ServeModelConfig.from_hf_config(model.config)
+        self.im: Optional[InferenceManager] = None
+        self.rm = None
+
+    # ------------------------------------------------------------------
+    def compile(
+        self,
+        max_requests: int = 8,
+        max_tokens_per_batch: int = 64,
+        max_seq_len: int = 512,
+        tp: int = 1,
+        max_spec_tokens: int = 0,
+        topk: int = 0,
+        generation_config: Optional[GenerationConfig] = None,
+        ssms: Sequence["LLM"] = (),
+        spec_width: int = 2,
+        spec_depth: int = 3,
+        dtype=None,
+        devices=None,
+    ) -> "LLM":
+        devices = devices if devices is not None else jax.devices()[:tp]
+        mesh = make_mesh({"tp": tp}, devices)
+        ff = FFModel(FFConfig(), mesh=mesh)
+        logits = build_model(ff, self.config, max_tokens_per_batch)
+        if ssms and not max_spec_tokens:
+            max_spec_tokens = 1 + spec_width * spec_depth
+        self.im = InferenceManager(
+            ff,
+            max_requests=max_requests,
+            max_tokens_per_batch=max_tokens_per_batch,
+            max_seq_len=max_seq_len,
+            max_spec_tokens=max_spec_tokens,
+            topk=topk,
+            outputs=logits,
+        )
+        if self._sd is not None:
+            params = convert_state_dict(self._sd, self.config, dtype or "float32")
+            params = place_params(params, self.im.plan)
+            self.im.init_operators_inference(params=params)
+        else:
+            self.im.init_operators_inference(dtype=dtype)
+
+        gen = generation_config or GenerationConfig()
+        if gen.eos_token_id is None and self.config.eos_token_id is not None:
+            gen = dataclass_replace(gen, eos_token_id=self.config.eos_token_id)
+        if ssms:
+            ssm = ssms[0]
+            if ssm.im is None:
+                ssm.compile(
+                    max_requests=max_requests,
+                    max_tokens_per_batch=max_tokens_per_batch,
+                    max_seq_len=max_seq_len,
+                    max_spec_tokens=max_spec_tokens,
+                    topk=max(spec_width, 1),
+                    devices=devices[:1],
+                    tp=1,
+                )
+            self.rm = SpecInferManager(
+                self.im, ssm.im, gen, width=spec_width, depth=spec_depth
+            )
+        else:
+            self.rm = RequestManager(self.im, gen)
+        return self
+
+    # ------------------------------------------------------------------
+    def generate(
+        self,
+        prompts: Union[str, Sequence],
+        max_new_tokens: Optional[int] = None,
+    ):
+        """Strings in → strings out (needs a tokenizer); id lists in → id
+        lists out."""
+        assert self.rm is not None, "call compile() first"
+        if not isinstance(prompts, str) and not len(prompts):
+            return []
+        single = isinstance(prompts, str) or isinstance(prompts[0], int)
+        if single:
+            prompts = [prompts]
+        texty = isinstance(prompts[0], str)
+        if texty:
+            if self.tokenizer is None:
+                raise ValueError("string prompts require a tokenizer")
+            ids = [self.tokenizer.encode(p) for p in prompts]
+        else:
+            ids = [list(p) for p in prompts]
+        outs = self.rm.generate(ids, max_new_tokens)
+        if texty:
+            outs = [self.tokenizer.decode(o) for o in outs]
+        return outs[0] if single else outs
+
+
+class SSM(LLM):
+    """Parity alias for the reference's draft-model class."""
+
+
+def dataclass_replace(obj, **kw):
+    import dataclasses
+
+    return dataclasses.replace(obj, **kw)

@@ -43,7 +43,9 @@ class ServeModelConfig:
     tie_word_embeddings: bool = False
     # opt/mpt/starcoder-family extras
     do_layer_norm_before: bool = True
+    word_embed_proj_dim: Optional[int] = None  # opt-350m embed != hidden
     parallel_attn: bool = False       # falcon: attn & mlp in parallel
+    bias: bool = False                # falcon-rw: linear biases
     use_alibi: bool = False           # mpt
     new_decoder_architecture: bool = False  # falcon >= 40b
 
@@ -67,6 +69,8 @@ class ServeModelConfig:
             if v is not None:
                 kw[name] = v
         # family-specific renames
+        if get("layer_norm_epsilon") is not None:  # falcon/gpt_bigcode
+            kw["layer_norm_eps"] = get("layer_norm_epsilon")
         if get("n_embd") is not None:      # starcoder/gpt_bigcode, mpt (d_model)
             kw["hidden_size"] = get("n_embd")
         if get("d_model") is not None:
@@ -83,10 +87,29 @@ class ServeModelConfig:
             kw["intermediate_size"] = get("ffn_dim")
         if get("n_inner") is not None and get("n_inner"):
             kw["intermediate_size"] = get("n_inner")
+        if get("expansion_ratio") is not None:  # mpt
+            kw["intermediate_size"] = get("expansion_ratio") * kw["hidden_size"]
+        if get("n_positions") is not None:  # gpt_bigcode
+            kw["max_position_embeddings"] = get("n_positions")
+        if get("num_kv_heads") is not None and get(
+            "new_decoder_architecture", False
+        ):  # falcon new-decoder GQA only; old arch ignores num_kv_heads
+            kw["num_key_value_heads"] = get("num_kv_heads")
         if get("multi_query", False):      # falcon-7b / starcoder MQA
             kw["num_key_value_heads"] = 1
         if get("alibi", None) is not None:
             kw["use_alibi"] = get("alibi")
+        attn_cfg = get("attn_config", None)  # mpt nests attention settings
+        if attn_cfg is not None:
+            aget = (lambda k, d=None: attn_cfg.get(k, d)) \
+                if isinstance(attn_cfg, dict) \
+                else (lambda k, d=None: getattr(attn_cfg, k, d))
+            if aget("kv_n_heads") is not None:
+                kw["num_key_value_heads"] = aget("kv_n_heads")
+            if aget("alibi") is not None:
+                kw["use_alibi"] = aget("alibi")
+        if get("model_type") == "gpt_bigcode" and "intermediate_size" not in kw:
+            kw["intermediate_size"] = 4 * kw["hidden_size"]
         return ServeModelConfig(**kw)
 
 

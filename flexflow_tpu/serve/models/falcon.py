@@ -1,0 +1,63 @@
+"""Falcon serve graph builder.
+
+Reference: ``inference/models/falcon.cc``.  Two supported decoder shapes:
+
+* Falcon-7B (``parallel_attn=True``, no biases): single pre-LN feeding
+  attention AND MLP in parallel, residual = x + attn + mlp.
+* Falcon-RW (``parallel_attn=False``, ``bias=True``): sequential pre-LN
+  blocks with ``post_attention_layernorm`` and biased linears.
+
+The ``new_decoder_architecture`` (40B/180B: dual ln_attn/ln_mlp + per-group
+interleaved fused QKV) is rejected explicitly until its weight layout is
+implemented.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import ServeModelConfig, register_model
+
+
+@register_model("falcon")
+def build_falcon(ff, cfg: ServeModelConfig, max_tokens: int):
+    if cfg.new_decoder_architecture:
+        raise NotImplementedError(
+            "falcon new_decoder_architecture (40B/180B) is not supported yet: "
+            "it needs ln_attn/ln_mlp and the per-kv-group interleaved QKV layout"
+        )
+    tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
+    x = ff.embedding(
+        tokens, cfg.vocab_size, cfg.hidden_size,
+        name="transformer.word_embeddings",
+    )
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.h.{i}"
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                          name=f"{p}.input_layernorm")
+        a = ff.inc_multihead_self_attention(
+            h, cfg.hidden_size, cfg.num_attention_heads, cfg.kv_heads,
+            cfg.hdim, rotary_embedding=not cfg.use_alibi,
+            rope_theta=cfg.rope_theta, use_bias=cfg.bias,
+            use_alibi=cfg.use_alibi, name=f"{p}.self_attention",
+        )
+        if cfg.parallel_attn:
+            # Falcon-7B: residual = x + attn + mlp, both from the same LN
+            m = ff.dense(h, cfg.intermediate_size, activation="gelu_exact",
+                         use_bias=cfg.bias, name=f"{p}.mlp.dense_h_to_4h")
+            m = ff.dense(m, cfg.hidden_size, use_bias=cfg.bias,
+                         name=f"{p}.mlp.dense_4h_to_h")
+            x = ff.add(x, ff.add(a, m, name=f"{p}.attn_mlp"),
+                       name=f"{p}.residual")
+        else:
+            # Falcon-RW: sequential blocks with a post-attention LN
+            x = ff.add(x, a, name=f"{p}.attn_residual")
+            h2 = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                               name=f"{p}.post_attention_layernorm")
+            m = ff.dense(h2, cfg.intermediate_size, activation="gelu_exact",
+                         use_bias=cfg.bias, name=f"{p}.mlp.dense_h_to_4h")
+            m = ff.dense(m, cfg.hidden_size, use_bias=cfg.bias,
+                         name=f"{p}.mlp.dense_4h_to_h")
+            x = ff.add(x, m, name=f"{p}.mlp_residual")
+    x = ff.layer_norm(x, eps=cfg.layer_norm_eps, name="transformer.ln_f")
+    return ff.dense(x, cfg.vocab_size, use_bias=False, name="lm_head")

@@ -1,0 +1,40 @@
+"""MPT serve graph builder.
+
+Reference: ``inference/models/mpt.cc`` — pre-LN (no-bias LayerNorm) decoder
+with ALiBi attention (no position embedding, no RoPE), exact-GELU MLP, no
+linear biases, tied LM head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import ServeModelConfig, register_model
+
+
+@register_model("mpt")
+def build_mpt(ff, cfg: ServeModelConfig, max_tokens: int):
+    tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
+    x = ff.embedding(
+        tokens, cfg.vocab_size, cfg.hidden_size, name="transformer.wte"
+    )
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.blocks.{i}"
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps, use_bias=False,
+                          name=f"{p}.norm_1")
+        a = ff.inc_multihead_self_attention(
+            h, cfg.hidden_size, cfg.num_attention_heads, cfg.kv_heads,
+            cfg.hdim, rotary_embedding=False, use_bias=False, use_alibi=True,
+            name=f"{p}.attn",
+        )
+        x = ff.add(x, a, name=f"{p}.attn_residual")
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps, use_bias=False,
+                          name=f"{p}.norm_2")
+        h = ff.dense(h, cfg.intermediate_size, activation="gelu_exact",
+                     use_bias=False, name=f"{p}.ffn.up_proj")
+        h = ff.dense(h, cfg.hidden_size, use_bias=False,
+                     name=f"{p}.ffn.down_proj")
+        x = ff.add(x, h, name=f"{p}.mlp_residual")
+    x = ff.layer_norm(x, eps=cfg.layer_norm_eps, use_bias=False,
+                      name="transformer.norm_f")
+    return ff.dense(x, cfg.vocab_size, use_bias=False, name="lm_head")

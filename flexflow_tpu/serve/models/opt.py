@@ -1,0 +1,62 @@
+"""OPT serve graph builder.
+
+Reference: ``inference/models/opt.cc`` (``OPT::create_opt_model``) — token +
+learned position embeddings (offset 2), biased attention/MLP (ReLU), tied LM
+head.  Handles both norm placements: pre-LN (``do_layer_norm_before=True``,
+every size except 350m, with a model-level final layer norm) and post-LN
+(opt-350m: LN applied after each residual add, no final norm), plus
+opt-350m's ``word_embed_proj_dim != hidden_size`` with its project_in/out
+linears.  Node names follow the HF ``facebook/opt-*`` state-dict layout.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import ServeModelConfig, register_model
+
+
+@register_model("opt")
+def build_opt(ff, cfg: ServeModelConfig, max_tokens: int):
+    embed_dim = cfg.word_embed_proj_dim or cfg.hidden_size
+    tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
+    x = ff.embedding(
+        tokens, cfg.vocab_size, embed_dim, name="model.decoder.embed_tokens"
+    )
+    if embed_dim != cfg.hidden_size:
+        x = ff.dense(x, cfg.hidden_size, use_bias=False,
+                     name="model.decoder.project_in")
+    x = ff.position_embedding(
+        x, cfg.max_position_embeddings, offset=2,
+        name="model.decoder.embed_positions",
+    )
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.decoder.layers.{i}"
+        pre = cfg.do_layer_norm_before
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                          name=f"{p}.self_attn_layer_norm") if pre else x
+        a = ff.inc_multihead_self_attention(
+            h, cfg.hidden_size, cfg.num_attention_heads, cfg.kv_heads,
+            cfg.hdim, rotary_embedding=False, use_bias=True,
+            name=f"{p}.self_attn",
+        )
+        x = ff.add(x, a, name=f"{p}.attn_residual")
+        if not pre:
+            x = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                              name=f"{p}.self_attn_layer_norm")
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                          name=f"{p}.final_layer_norm") if pre else x
+        h = ff.dense(h, cfg.intermediate_size, activation="relu",
+                     use_bias=True, name=f"{p}.fc1")
+        h = ff.dense(h, cfg.hidden_size, use_bias=True, name=f"{p}.fc2")
+        x = ff.add(x, h, name=f"{p}.mlp_residual")
+        if not pre:
+            x = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                              name=f"{p}.final_layer_norm")
+    if cfg.do_layer_norm_before:
+        x = ff.layer_norm(x, eps=cfg.layer_norm_eps,
+                          name="model.decoder.final_layer_norm")
+    if embed_dim != cfg.hidden_size:
+        x = ff.dense(x, embed_dim, use_bias=False,
+                     name="model.decoder.project_out")
+    return ff.dense(x, cfg.vocab_size, use_bias=False, name="lm_head")

@@ -1,0 +1,39 @@
+"""StarCoder (gpt_bigcode) serve graph builder.
+
+Reference: ``inference/models/starcoder.cc`` — learned absolute position
+embeddings, pre-LN decoder with multi-query attention (biased, no RoPE),
+tanh-GELU MLP, tied LM head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import ServeModelConfig, register_model
+
+
+@register_model("gpt_bigcode")
+def build_starcoder(ff, cfg: ServeModelConfig, max_tokens: int):
+    tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
+    x = ff.embedding(
+        tokens, cfg.vocab_size, cfg.hidden_size, name="transformer.wte"
+    )
+    x = ff.position_embedding(
+        x, cfg.max_position_embeddings, offset=0, name="transformer.wpe"
+    )
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.h.{i}"
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps, name=f"{p}.ln_1")
+        a = ff.inc_multihead_self_attention(
+            h, cfg.hidden_size, cfg.num_attention_heads, cfg.kv_heads,
+            cfg.hdim, rotary_embedding=False, use_bias=True,
+            name=f"{p}.attn",
+        )
+        x = ff.add(x, a, name=f"{p}.attn_residual")
+        h = ff.layer_norm(x, eps=cfg.layer_norm_eps, name=f"{p}.ln_2")
+        h = ff.dense(h, cfg.intermediate_size, activation="gelu",
+                     use_bias=True, name=f"{p}.mlp.c_fc")
+        h = ff.dense(h, cfg.hidden_size, use_bias=True, name=f"{p}.mlp.c_proj")
+        x = ff.add(x, h, name=f"{p}.mlp_residual")
+    x = ff.layer_norm(x, eps=cfg.layer_norm_eps, name="transformer.ln_f")
+    return ff.dense(x, cfg.vocab_size, use_bias=False, name="lm_head")

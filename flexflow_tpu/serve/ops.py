@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.graph import ParamSpec, TensorSpec
-from ..core.op import Op, OpContext, ShardingSolution, register_op
+from ..core.op import Op, OpContext, ShardingSolution, bias_once, register_op
 from ..core.sharding import TensorSharding
 from .batch_config import (
     BatchConfig,
@@ -44,6 +44,19 @@ from .batch_config import (
 )
 
 NEG_INF = -1e30
+
+
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (Press et al.; matches HF's power-of-2 recipe)."""
+    import math as _math
+
+    n = 2 ** _math.floor(_math.log2(num_heads))
+    base = jnp.arange(1, n + 1, dtype=jnp.float32)
+    slopes = 2.0 ** (-8.0 * base / n)
+    if n < num_heads:  # interleave the overflow heads at half offsets
+        extra = jnp.arange(1, 2 * (num_heads - n) + 1, 2, dtype=jnp.float32)
+        slopes = jnp.concatenate([slopes, 2.0 ** (-4.0 * extra / n)])
+    return slopes[:num_heads]
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -87,6 +100,7 @@ class IncMultiHeadSelfAttention(Op):
         rope_theta: float = 10000.0,
         use_bias: bool = False,
         scaling_factor: Optional[float] = None,
+        use_alibi: bool = False,
         dtype=jnp.float32,
     ):
         self.embed_dim = int(embed_dim)
@@ -99,6 +113,7 @@ class IncMultiHeadSelfAttention(Op):
         self.rotary_embedding = bool(rotary_embedding)
         self.rope_theta = float(rope_theta)
         self.use_bias = bool(use_bias)
+        self.use_alibi = bool(use_alibi)
         self.scaling_factor = (
             float(scaling_factor)
             if scaling_factor is not None
@@ -141,6 +156,12 @@ class IncMultiHeadSelfAttention(Op):
                     ),
                 )
             )
+            ps.append(
+                ParamSpec(
+                    "o_bias",
+                    TensorSpec((self.embed_dim,), jnp.dtype(self.dtype)),
+                )
+            )
         return ps
 
     # ---- state ---------------------------------------------------------
@@ -167,6 +188,12 @@ class IncMultiHeadSelfAttention(Op):
             )
             out["sk"] = (sp_shape, self.dtype, sh)
             out["sv"] = (sp_shape, self.dtype, sh)
+            if self.use_alibi:
+                # absolute position of each spec-buffer slot (ALiBi needs key
+                # positions; rope bakes them into sk at write time instead)
+                out["spec_pos"] = (
+                    sp_shape[:2], "int32", TensorSharding.replicated(2)
+                )
         return out
 
     # ---- compute -------------------------------------------------------
@@ -198,6 +225,9 @@ class IncMultiHeadSelfAttention(Op):
             params["o_proj"],
             preferred_element_type=jnp.float32,
         )
+        if self.use_bias:
+            head = tuple(ctx.config.get("head", ())) if ctx.config else ()
+            y = y + bias_once(params["o_bias"], head, ctx)
         return [y.astype(self.dtype)]
 
     def _project(self, x, qkv_w, qkv_b, bc):
@@ -240,6 +270,12 @@ class IncMultiHeadSelfAttention(Op):
             "tkgd,tskd->tkgs", q, k_tok, preferred_element_type=jnp.float32
         )
         scores = scores * self.scaling_factor
+        if self.use_alibi:
+            slopes = alibi_slopes(self.num_q_heads).reshape(
+                self.num_kv_heads, self.q_per_kv
+            )
+            rel = (jnp.arange(s)[None, :] - pos[:, None]).astype(jnp.float32)
+            scores = scores + slopes[None, :, :, None] * rel[:, None, None, :]
         scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
@@ -285,6 +321,11 @@ class IncMultiHeadSelfAttention(Op):
         spec_idx = jnp.clip(bc.spec_index, 0, sk.shape[1] - 1)
         sk = sk.at[rows, spec_idx].set(k.astype(sk.dtype))
         sv = sv.at[rows, spec_idx].set(v.astype(sv.dtype))
+        spec_pos = None
+        if self.use_alibi:
+            spec_pos = state["spec_pos"].at[rows, spec_idx].set(
+                base.token_position
+            )
 
         k_cache_tok = kc[rows]   # [T, S, KV, D]
         v_cache_tok = vc[rows]
@@ -303,6 +344,15 @@ class IncMultiHeadSelfAttention(Op):
         sc_p = jnp.einsum(
             "tkgd,tpkd->tkgp", q, k_spec_tok, preferred_element_type=jnp.float32
         ) * self.scaling_factor
+        if self.use_alibi:
+            slopes = alibi_slopes(self.num_q_heads).reshape(
+                self.num_kv_heads, self.q_per_kv
+            )[None, :, :, None]
+            qpos = base.token_position
+            rel_c = (jnp.arange(s)[None, :] - qpos[:, None]).astype(jnp.float32)
+            rel_p = (spec_pos[rows] - qpos[:, None]).astype(jnp.float32)
+            sc_c = sc_c + slopes * rel_c[:, None, None, :]
+            sc_p = sc_p + slopes * rel_p[:, None, None, :]
         sc_c = jnp.where(cmask[:, None, None, :], sc_c, NEG_INF)
         sc_p = jnp.where(amask[:, None, None, :], sc_p, NEG_INF)
         scores = jnp.concatenate([sc_c, sc_p], axis=-1)
@@ -315,6 +365,8 @@ class IncMultiHeadSelfAttention(Op):
         out = out.reshape(t, self.num_q_heads, self.head_dim).astype(q.dtype)
         new_state = dict(state)  # k/v already carry any commit from _commit()
         new_state["sk"], new_state["sv"] = sk, sv
+        if spec_pos is not None:
+            new_state["spec_pos"] = spec_pos
         return out, new_state
 
     # ---- parallelization ----------------------------------------------
@@ -347,6 +399,61 @@ class IncMultiHeadSelfAttention(Op):
         proj = 2 * t * e * (qh + 2 * self.num_kv_heads) * d + 2 * t * qh * d * e
         attn = 2 * t * qh * d * s * 2
         return proj + attn
+
+
+@register_op
+class PositionEmbedding(Op):
+    """Learned absolute position embedding, positions from the BatchConfig.
+
+    Reference: OPT/StarCoder serve graphs in ``inference/models/opt.cc`` /
+    ``starcoder.cc`` feed per-token positions alongside token ids; here the
+    positions already ride the step's BatchConfig, so this op needs no graph
+    input — it adds ``weight[token_position + offset]`` (OPT uses offset 2).
+    """
+
+    type_name = "position_embedding"
+
+    def __init__(self, num_positions: int, out_dim: int, offset: int = 0,
+                 dtype=jnp.float32):
+        self.num_positions = int(num_positions)
+        self.out_dim = int(out_dim)
+        self.offset = int(offset)
+        self.dtype = jnp.dtype(dtype).name
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]  # [T, E]: the token embedding to add to
+        if x.shape[-1] != self.out_dim:
+            raise ValueError(f"expected dim {self.out_dim}, got {x}")
+        return [TensorSpec(x.shape, jnp.dtype(self.dtype))]
+
+    def params(self):
+        return [
+            ParamSpec(
+                "weight",
+                TensorSpec(
+                    (self.num_positions + self.offset, self.out_dim),
+                    jnp.dtype(self.dtype),
+                ),
+            )
+        ]
+
+    def lower(self, ctx, inputs, params):
+        bc = ctx.extras.get("batch_config")
+        if bc is None:
+            raise ValueError("position_embedding requires a batch_config")
+        base = bc if isinstance(bc, BatchConfig) else bc.base
+        pos = jnp.clip(
+            base.token_position + self.offset, 0,
+            self.num_positions + self.offset - 1,
+        )
+        return [inputs[0] + params["weight"][pos].astype(inputs[0].dtype)]
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        sh = TensorSharding.replicated(in_specs[0].ndim)
+        return ShardingSolution(
+            inputs=[sh], outputs=[sh],
+            params={"weight": TensorSharding.replicated(2)},
+        )
 
 
 @register_op

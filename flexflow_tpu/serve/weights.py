@@ -1,0 +1,342 @@
+"""HuggingFace weight import: torch state dict → flexflow_tpu param tree.
+
+Reference: ``inference/file_loader.cc`` (``FileDataLoader::load_weights``) and
+``python/flexflow/serve/serve.py``'s download-and-convert path.  The reference
+exports HF checkpoints to raw binary per-tensor files and loads them into
+Legion regions with manual TP slicing; here the conversion is a pure name/
+layout map into the param pytree and sharding is applied by ``device_put``
+with the plan's NamedShardings — GSPMD handles the slicing.
+
+Layout notes (torch ``nn.Linear.weight`` is ``[out, in]``; our Linear kernel
+is ``[in, out]``, so every projection transposes):
+
+* ``q/k/v_proj`` fuse into the kv-head-major ``qkv [E, KV, q_per_kv+2, D]``
+  used by :class:`~flexflow_tpu.serve.ops.IncMultiHeadSelfAttention` (one MXU
+  GEMM, TP = shard dim 1).
+* ``o_proj.weight [E, QH*D]`` → ``[QH*D, E]``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.base import ServeModelConfig
+
+
+def _t(x) -> np.ndarray:
+    """torch tensor (any dtype/device) -> float32 numpy."""
+    import torch
+
+    if isinstance(x, torch.Tensor):
+        return x.detach().to(torch.float32).cpu().numpy()
+    return np.asarray(x, np.float32)
+
+
+def fuse_qkv(qw, kw, vw, cfg: ServeModelConfig) -> np.ndarray:
+    """[QH*D,E],[KV*D,E],[KV*D,E] (torch layout) -> [E, KV, q_per_kv+2, D]."""
+    e = cfg.hidden_size
+    kv, d = cfg.kv_heads, cfg.hdim
+    gq = cfg.num_attention_heads // kv
+    q = _t(qw).T.reshape(e, kv, gq, d)
+    k = _t(kw).T.reshape(e, kv, 1, d)
+    v = _t(vw).T.reshape(e, kv, 1, d)
+    return np.concatenate([q, k, v], axis=2)
+
+
+def convert_llama_state_dict(
+    sd: Dict, cfg: ServeModelConfig, dtype=jnp.float32
+) -> Dict[str, Dict[str, jax.Array]]:
+    """HF LLaMA ``state_dict()`` → ``{node_name: {param_name: array}}``.
+
+    Node names in the serve graph intentionally equal HF module prefixes
+    (see ``models/llama.py``), so this is mostly a suffix map.
+    """
+    params: Dict[str, Dict[str, jax.Array]] = {}
+
+    def put(node, pname, arr):
+        params.setdefault(node, {})[pname] = jnp.asarray(arr, dtype)
+
+    put("model.embed_tokens", "weight", _t(sd["model.embed_tokens.weight"]))
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}"
+        put(f"{p}.input_layernorm", "gamma", _t(sd[f"{p}.input_layernorm.weight"]))
+        put(
+            f"{p}.post_attention_layernorm", "gamma",
+            _t(sd[f"{p}.post_attention_layernorm.weight"]),
+        )
+        put(
+            f"{p}.self_attn", "qkv",
+            fuse_qkv(
+                sd[f"{p}.self_attn.q_proj.weight"],
+                sd[f"{p}.self_attn.k_proj.weight"],
+                sd[f"{p}.self_attn.v_proj.weight"],
+                cfg,
+            ),
+        )
+        put(f"{p}.self_attn", "o_proj", _t(sd[f"{p}.self_attn.o_proj.weight"]).T)
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            put(f"{p}.mlp.{proj}", "kernel", _t(sd[f"{p}.mlp.{proj}.weight"]).T)
+    put("model.norm", "gamma", _t(sd["model.norm.weight"]))
+    if "lm_head.weight" in sd:
+        put("lm_head", "kernel", _t(sd["lm_head.weight"]).T)
+    else:  # tied embeddings
+        put("lm_head", "kernel", _t(sd["model.embed_tokens.weight"]).T)
+    return params
+
+
+def fuse_qkv_rows(w, cfg: ServeModelConfig) -> np.ndarray:
+    """Pre-fused row-stacked ``[QH*D + 2*KV*D, E]`` (q|k|v, q heads in
+    kv-major order — HF falcon/mpt/gpt_bigcode layouts) → our
+    ``[E, KV, q_per_kv+2, D]``."""
+    e = cfg.hidden_size
+    kv, d = cfg.kv_heads, cfg.hdim
+    qh = cfg.num_attention_heads
+    w = _t(w)
+    q, k, v = np.split(w, [qh * d, qh * d + kv * d], axis=0)
+    return np.concatenate(
+        [
+            q.T.reshape(e, kv, qh // kv, d),
+            k.T.reshape(e, kv, 1, d),
+            v.T.reshape(e, kv, 1, d),
+        ],
+        axis=2,
+    )
+
+
+def fuse_qkv_bias(qb, kb, vb, cfg: ServeModelConfig) -> np.ndarray:
+    kv, d = cfg.kv_heads, cfg.hdim
+    gq = cfg.num_attention_heads // kv
+    return np.concatenate(
+        [
+            _t(qb).reshape(kv, gq, d),
+            _t(kb).reshape(kv, 1, d),
+            _t(vb).reshape(kv, 1, d),
+        ],
+        axis=1,
+    )
+
+
+def fuse_qkv_rows_bias(b, cfg: ServeModelConfig) -> np.ndarray:
+    kv, d = cfg.kv_heads, cfg.hdim
+    qh = cfg.num_attention_heads
+    qb, kb, vb = np.split(_t(b), [qh * d, qh * d + kv * d])
+    return fuse_qkv_bias(qb, kb, vb, cfg)
+
+
+def convert_opt_state_dict(sd, cfg: ServeModelConfig, dtype=jnp.float32):
+    params: Dict[str, Dict[str, jax.Array]] = {}
+
+    def put(node, pname, arr):
+        params.setdefault(node, {})[pname] = jnp.asarray(arr, dtype)
+
+    def ln(node, key):
+        put(node, "gamma", _t(sd[f"{key}.weight"]))
+        put(node, "beta", _t(sd[f"{key}.bias"]))
+
+    put("model.decoder.embed_tokens", "weight",
+        _t(sd["model.decoder.embed_tokens.weight"]))
+    put("model.decoder.embed_positions", "weight",
+        _t(sd["model.decoder.embed_positions.weight"]))
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.decoder.layers.{i}"
+        ln(f"{p}.self_attn_layer_norm", f"{p}.self_attn_layer_norm")
+        put(
+            f"{p}.self_attn", "qkv",
+            fuse_qkv(
+                sd[f"{p}.self_attn.q_proj.weight"],
+                sd[f"{p}.self_attn.k_proj.weight"],
+                sd[f"{p}.self_attn.v_proj.weight"],
+                cfg,
+            ),
+        )
+        put(
+            f"{p}.self_attn", "qkv_bias",
+            fuse_qkv_bias(
+                sd[f"{p}.self_attn.q_proj.bias"],
+                sd[f"{p}.self_attn.k_proj.bias"],
+                sd[f"{p}.self_attn.v_proj.bias"],
+                cfg,
+            ),
+        )
+        put(f"{p}.self_attn", "o_proj", _t(sd[f"{p}.self_attn.out_proj.weight"]).T)
+        put(f"{p}.self_attn", "o_bias", _t(sd[f"{p}.self_attn.out_proj.bias"]))
+        ln(f"{p}.final_layer_norm", f"{p}.final_layer_norm")
+        for fc in ("fc1", "fc2"):
+            put(f"{p}.{fc}", "kernel", _t(sd[f"{p}.{fc}.weight"]).T)
+            put(f"{p}.{fc}", "bias", _t(sd[f"{p}.{fc}.bias"]))
+    if "model.decoder.final_layer_norm.weight" in sd:  # pre-LN variants only
+        ln("model.decoder.final_layer_norm", "model.decoder.final_layer_norm")
+    for proj in ("project_in", "project_out"):  # opt-350m embed projection
+        key = f"model.decoder.{proj}.weight"
+        if key in sd:
+            put(f"model.decoder.{proj}", "kernel", _t(sd[key]).T)
+    lm = sd.get("lm_head.weight", sd["model.decoder.embed_tokens.weight"])
+    put("lm_head", "kernel", _t(lm).T)
+    return params
+
+
+def convert_falcon_state_dict(sd, cfg: ServeModelConfig, dtype=jnp.float32):
+    if cfg.new_decoder_architecture:
+        raise NotImplementedError(
+            "falcon new_decoder_architecture weight layout is not supported"
+        )
+    params: Dict[str, Dict[str, jax.Array]] = {}
+
+    def put(node, pname, arr):
+        params.setdefault(node, {})[pname] = jnp.asarray(arr, dtype)
+
+    def ln(node, key):
+        put(node, "gamma", _t(sd[f"{key}.weight"]))
+        put(node, "beta", _t(sd[f"{key}.bias"]))
+
+    put("transformer.word_embeddings", "weight",
+        _t(sd["transformer.word_embeddings.weight"]))
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.h.{i}"
+        ln(f"{p}.input_layernorm", f"{p}.input_layernorm")
+        if not cfg.parallel_attn:  # falcon-rw sequential layout
+            ln(f"{p}.post_attention_layernorm", f"{p}.post_attention_layernorm")
+        # falcon's fused weight is already kv-head-major interleaved
+        # (HF _split_heads: view(heads, 3, D) / view(heads+2, D) for MQA),
+        # which IS our [E, KV, q_per_kv+2, D] layout — a straight reshape
+        put(f"{p}.self_attention", "qkv",
+            _t(sd[f"{p}.self_attention.query_key_value.weight"]).T.reshape(
+                cfg.hidden_size, cfg.kv_heads,
+                cfg.num_attention_heads // cfg.kv_heads + 2, cfg.hdim))
+        put(f"{p}.self_attention", "o_proj",
+            _t(sd[f"{p}.self_attention.dense.weight"]).T)
+        put(f"{p}.mlp.dense_h_to_4h", "kernel",
+            _t(sd[f"{p}.mlp.dense_h_to_4h.weight"]).T)
+        put(f"{p}.mlp.dense_4h_to_h", "kernel",
+            _t(sd[f"{p}.mlp.dense_4h_to_h.weight"]).T)
+        if cfg.bias:
+            put(f"{p}.self_attention", "qkv_bias",
+                _t(sd[f"{p}.self_attention.query_key_value.bias"]).reshape(
+                    cfg.kv_heads,
+                    cfg.num_attention_heads // cfg.kv_heads + 2, cfg.hdim))
+            put(f"{p}.self_attention", "o_bias",
+                _t(sd[f"{p}.self_attention.dense.bias"]))
+            put(f"{p}.mlp.dense_h_to_4h", "bias",
+                _t(sd[f"{p}.mlp.dense_h_to_4h.bias"]))
+            put(f"{p}.mlp.dense_4h_to_h", "bias",
+                _t(sd[f"{p}.mlp.dense_4h_to_h.bias"]))
+    ln("transformer.ln_f", "transformer.ln_f")
+    lm = sd.get("lm_head.weight", sd["transformer.word_embeddings.weight"])
+    put("lm_head", "kernel", _t(lm).T)
+    return params
+
+
+def convert_mpt_state_dict(sd, cfg: ServeModelConfig, dtype=jnp.float32):
+    params: Dict[str, Dict[str, jax.Array]] = {}
+
+    def put(node, pname, arr):
+        params.setdefault(node, {})[pname] = jnp.asarray(arr, dtype)
+
+    put("transformer.wte", "weight", _t(sd["transformer.wte.weight"]))
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.blocks.{i}"
+        put(f"{p}.norm_1", "gamma", _t(sd[f"{p}.norm_1.weight"]))
+        put(f"{p}.norm_2", "gamma", _t(sd[f"{p}.norm_2.weight"]))
+        put(f"{p}.attn", "qkv", fuse_qkv_rows(sd[f"{p}.attn.Wqkv.weight"], cfg))
+        put(f"{p}.attn", "o_proj", _t(sd[f"{p}.attn.out_proj.weight"]).T)
+        put(f"{p}.ffn.up_proj", "kernel", _t(sd[f"{p}.ffn.up_proj.weight"]).T)
+        put(f"{p}.ffn.down_proj", "kernel",
+            _t(sd[f"{p}.ffn.down_proj.weight"]).T)
+    put("transformer.norm_f", "gamma", _t(sd["transformer.norm_f.weight"]))
+    lm = sd.get("lm_head.weight", sd["transformer.wte.weight"])
+    put("lm_head", "kernel", _t(lm).T)
+    return params
+
+
+def convert_starcoder_state_dict(sd, cfg: ServeModelConfig, dtype=jnp.float32):
+    params: Dict[str, Dict[str, jax.Array]] = {}
+
+    def put(node, pname, arr):
+        params.setdefault(node, {})[pname] = jnp.asarray(arr, dtype)
+
+    def ln(node, key):
+        put(node, "gamma", _t(sd[f"{key}.weight"]))
+        put(node, "beta", _t(sd[f"{key}.bias"]))
+
+    put("transformer.wte", "weight", _t(sd["transformer.wte.weight"]))
+    put("transformer.wpe", "weight", _t(sd["transformer.wpe.weight"]))
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.h.{i}"
+        ln(f"{p}.ln_1", f"{p}.ln_1")
+        ln(f"{p}.ln_2", f"{p}.ln_2")
+        put(f"{p}.attn", "qkv", fuse_qkv_rows(sd[f"{p}.attn.c_attn.weight"], cfg))
+        put(f"{p}.attn", "qkv_bias",
+            fuse_qkv_rows_bias(sd[f"{p}.attn.c_attn.bias"], cfg))
+        put(f"{p}.attn", "o_proj", _t(sd[f"{p}.attn.c_proj.weight"]).T)
+        put(f"{p}.attn", "o_bias", _t(sd[f"{p}.attn.c_proj.bias"]))
+        for fc in ("c_fc", "c_proj"):
+            put(f"{p}.mlp.{fc}", "kernel", _t(sd[f"{p}.mlp.{fc}.weight"]).T)
+            put(f"{p}.mlp.{fc}", "bias", _t(sd[f"{p}.mlp.{fc}.bias"]))
+    ln("transformer.ln_f", "transformer.ln_f")
+    lm = sd.get("lm_head.weight", sd["transformer.wte.weight"])
+    put("lm_head", "kernel", _t(lm).T)
+    return params
+
+
+CONVERTERS = {
+    "llama": convert_llama_state_dict,
+    "opt": convert_opt_state_dict,
+    "falcon": convert_falcon_state_dict,
+    "mpt": convert_mpt_state_dict,
+    "gpt_bigcode": convert_starcoder_state_dict,
+}
+
+
+def convert_state_dict(sd, cfg: ServeModelConfig, dtype=jnp.float32):
+    if cfg.model_type not in CONVERTERS:
+        raise ValueError(
+            f"no weight converter for {cfg.model_type!r}; "
+            f"known: {sorted(CONVERTERS)}"
+        )
+    return CONVERTERS[cfg.model_type](sd, cfg, dtype)
+
+
+def load_hf_model(name_or_path: str):
+    """Load a local HF checkpoint (config + weights + tokenizer if present).
+
+    Returns (state_dict, ServeModelConfig, tokenizer_or_None).  Network
+    download is NOT attempted (``local_files_only=True``) — ship checkpoints
+    to disk first, as the reference's weight-export flow does.
+    """
+    import transformers
+
+    hf_cfg = transformers.AutoConfig.from_pretrained(
+        name_or_path, local_files_only=True
+    )
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        name_or_path, local_files_only=True, torch_dtype="float32"
+    )
+    tok = None
+    try:
+        tok = transformers.AutoTokenizer.from_pretrained(
+            name_or_path, local_files_only=True
+        )
+    except Exception:
+        pass
+    return model.state_dict(), ServeModelConfig.from_hf_config(hf_cfg), tok
+
+
+def place_params(params, plan):
+    """device_put converted params according to the plan's shardings."""
+    mesh = plan.mesh
+    if mesh.size == 1:
+        return params
+    out = {}
+    for node, sub in params.items():
+        shs = plan.param_shardings.get(node, {})
+        out[node] = {
+            k: jax.device_put(v, shs[k].named_sharding(mesh))
+            if k in shs
+            else v
+            for k, v in sub.items()
+        }
+    return out

@@ -1,0 +1,123 @@
+"""Serve model zoo: every family must exactly match HF transformers greedily.
+
+Reference gate (SURVEY.md §4): ``tests/inference`` runs incr_decoding across
+model families and compares against ``huggingface_inference.py``.  Hermetic
+version: tiny random HF models built in-process, exact greedy token equality.
+Covers: OPT (learned positions offset 2, biased attn/MLP, ReLU), Falcon
+(parallel attn, MQA, RoPE), MPT (ALiBi, no biases), StarCoder (MQA, learned
+positions, tanh-GELU).
+"""
+
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from flexflow_tpu.serve import LLM, GenerationConfig
+
+PROMPTS = [[5, 9, 13, 44, 2], [81, 3, 17]]
+N_NEW = 8
+
+
+def hf_greedy(model, prompt, n_new):
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        out = model.generate(
+            ids, max_new_tokens=n_new, do_sample=False,
+            eos_token_id=None, pad_token_id=0,
+        )
+    return out[0, len(prompt):].tolist()
+
+
+def run_family(hf_model, atol_note=""):
+    llm = LLM(hf_model)
+    llm.compile(
+        max_requests=2, max_tokens_per_batch=16, max_seq_len=64,
+        generation_config=GenerationConfig(stop_on_eos=False),
+    )
+    got = llm.generate(PROMPTS, max_new_tokens=N_NEW)
+    for p, g in zip(PROMPTS, got):
+        want = hf_greedy(hf_model, p, N_NEW)
+        assert g == want, f"{atol_note} prompt {p}: ours {g} != HF {want}"
+
+
+def test_opt_matches_hf():
+    torch.manual_seed(1)
+    cfg = transformers.OPTConfig(
+        vocab_size=97, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, activation_function="relu",
+        word_embed_proj_dim=32,
+    )
+    model = transformers.OPTForCausalLM(cfg).eval().to(torch.float32)
+    run_family(model, "opt")
+
+
+def test_falcon_matches_hf():
+    torch.manual_seed(2)
+    cfg = transformers.FalconConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+        rope_theta=10000.0,
+    )
+    model = transformers.FalconForCausalLM(cfg).eval().to(torch.float32)
+    run_family(model, "falcon")
+
+
+def test_falcon_rw_matches_hf():
+    # falcon-rw-1b style: sequential blocks, biases, ALiBi, no MQA
+    torch.manual_seed(5)
+    cfg = transformers.FalconConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, parallel_attn=False,
+        new_decoder_architecture=False, bias=True, alibi=True,
+    )
+    model = transformers.FalconForCausalLM(cfg).eval().to(torch.float32)
+    run_family(model, "falcon-rw")
+
+
+def test_falcon_new_arch_rejected():
+    torch.manual_seed(6)
+    cfg = transformers.FalconConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, new_decoder_architecture=True, num_kv_heads=2,
+    )
+    model = transformers.FalconForCausalLM(cfg).eval()
+    with pytest.raises(NotImplementedError):
+        LLM(model).compile(max_requests=2, max_tokens_per_batch=8,
+                           max_seq_len=32)
+
+
+def test_opt_350m_style_matches_hf():
+    # opt-350m shape: post-LN, word_embed_proj_dim != hidden_size
+    torch.manual_seed(7)
+    cfg = transformers.OPTConfig(
+        vocab_size=97, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=False, activation_function="relu",
+        word_embed_proj_dim=16,
+    )
+    model = transformers.OPTForCausalLM(cfg).eval().to(torch.float32)
+    run_family(model, "opt-350m-style")
+
+
+def test_mpt_matches_hf():
+    torch.manual_seed(3)
+    cfg = transformers.MptConfig(
+        vocab_size=97, d_model=32, n_heads=4, n_layers=2, expansion_ratio=2,
+        max_seq_len=64, no_bias=True,
+    )
+    model = transformers.MptForCausalLM(cfg).eval().to(torch.float32)
+    run_family(model, "mpt")
+
+
+def test_starcoder_matches_hf():
+    torch.manual_seed(4)
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=97, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        n_inner=64, multi_query=True,
+        activation_function="gelu_pytorch_tanh",
+    )
+    model = transformers.GPTBigCodeForCausalLM(cfg).eval().to(torch.float32)
+    run_family(model, "starcoder")
