@@ -75,6 +75,7 @@ class InferenceManager:
         tp_axes: Optional[Tuple[str, ...]] = None,
         topk: int = 0,
         outputs=None,
+        use_pallas: str = "auto",
     ):
         """``model`` is an FFModel whose graph was built by a serve builder.
 
@@ -107,6 +108,25 @@ class InferenceManager:
         self._token_tid = model.graph.input_tids[0]
         self.params = None
         self.state = None
+        # Pallas decode kernel: replaces the cache-row-gather attention on
+        # the incremental path.  "auto" = on for a single-device mesh on TPU
+        # (under TP the step runs in GSPMD global mode where pallas_call
+        # would need a shard_map wrapper — future work); True forces it on
+        # (interpret mode off-TPU, for tests); False = pure-JAX path.
+        # INIT-ONLY: the flags are baked into the jitted step at first trace;
+        # mutating the attributes afterwards has no effect.
+        backend = jax.default_backend()
+        trivial = mesh is None or mesh.size == 1
+        if use_pallas == "auto":
+            self.use_pallas = trivial and backend == "tpu"
+        else:
+            if use_pallas and not trivial:
+                raise ValueError(
+                    "use_pallas=True requires a single-device mesh (the "
+                    "kernel is not yet wired through shard_map for TP)"
+                )
+            self.use_pallas = bool(use_pallas)
+        self.pallas_interpret = backend != "tpu"
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
 
     # ------------------------------------------------------------------
@@ -157,7 +177,11 @@ class InferenceManager:
             params,
             {self._token_tid: base.tokens},
             state=state,
-            extras={"batch_config": bc},
+            extras={
+                "batch_config": bc,
+                "pallas_decode": self.use_pallas,
+                "pallas_interpret": self.pallas_interpret,
+            },
         )
         logits = outs[0].astype(jnp.float32)  # [T, vocab]
         token_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -215,7 +215,7 @@ class IncMultiHeadSelfAttention(Op):
         elif isinstance(bc, TreeSearchBatchConfig):
             out, state = self._tree_attend(q, k, v, state, bc)
         else:
-            out, state = self._inc_attend(q, k, v, state, bc)
+            out, state = self._inc_attend(q, k, v, state, bc, ctx)
 
         ctx.extras["state_out"] = state
         # [T, QH, D] -> [T, QH*D] -> o_proj (row-parallel under TP)
@@ -253,14 +253,30 @@ class IncMultiHeadSelfAttention(Op):
         r = bc_base.request_index
         return jnp.where(r >= 0, r, max_requests)
 
-    def _inc_attend(self, q, k, v, state, bc: BatchConfig):
+    def _inc_attend(self, q, k, v, state, bc: BatchConfig, ctx=None):
         kc, vc = state["k"], state["v"]
         nreq = kc.shape[0] - 1
         rows = self._rows(bc, nreq)
         pos = bc.token_position
         kc = kc.at[rows, pos].set(k.astype(kc.dtype))
         vc = vc.at[rows, pos].set(v.astype(vc.dtype))
-        # gather each token's cache row: [T, S, KV, D]
+        if ctx is not None and ctx.extras.get("pallas_decode"):
+            from ..ops.pallas.attention import decode_attention
+
+            t = q.shape[0]
+            out = decode_attention(
+                q.reshape(t, self.num_q_heads, self.head_dim),
+                kc, vc, rows, pos,
+                scale=self.scaling_factor,
+                slopes=alibi_slopes(self.num_q_heads)
+                if self.use_alibi else None,
+                use_alibi=self.use_alibi,
+                interpret=bool(ctx.extras.get("pallas_interpret")),
+            )
+            new_state = dict(state)
+            new_state["k"], new_state["v"] = kc, vc
+            return out, new_state
+        # fallback: gather each token's cache row: [T, S, KV, D]
         k_tok = kc[rows]
         v_tok = vc[rows]
         s = k_tok.shape[1]
