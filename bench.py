@@ -1,15 +1,79 @@
 """Benchmark entry point: prints ONE JSON line for the driver.
 
-Current benchmark: MNIST-MLP training throughput (BASELINE config #1) on the
-available device.  ``vs_baseline`` compares against a plain un-jitted
-layer-by-layer JAX implementation of the same model (the stand-in for the
-reference's per-op task-launch execution until reference numbers exist).
+Headline metric (north-star #2 currency): steady-state incremental-decoding
+throughput through the serve stack — full batch of decode tokens per jitted
+step (Pallas flash-decode kernel on TPU), in tokens/sec.  ``vs_baseline``
+compares against the same step with the kernel disabled (the gather-based
+pure-JAX attention path, our stand-in for the reference's unfused execution
+until reference hardware numbers exist).
+
+Also measures MNIST-MLP train throughput (BASELINE config #1) — kept as a
+secondary field inside the same JSON line.
 """
 
 import json
 import time
 
 import numpy as np
+
+
+def build_im(use_pallas, layers=4, hidden=2048, heads=16, kv=16,
+             max_requests=8, max_seq=1024, vocab=32000):
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.mesh import make_mesh
+    from flexflow_tpu.serve import (
+        InferenceManager,
+        ServeModelConfig,
+        build_model,
+    )
+
+    cfg = ServeModelConfig(
+        model_type="llama", vocab_size=vocab, hidden_size=hidden,
+        intermediate_size=int(hidden * 2.6875) // 128 * 128,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv,
+    )
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff = FFModel(FFConfig(), mesh=mesh)
+    logits = build_model(ff, cfg, max_tokens=max_requests)
+    im = InferenceManager(
+        ff, max_requests=max_requests, max_tokens_per_batch=max_requests,
+        max_seq_len=max_seq, outputs=logits, use_pallas=use_pallas,
+    )
+    im.init_operators_inference(rng=jax.random.PRNGKey(0), dtype="bfloat16")
+    return im
+
+
+def bench_decode(use_pallas, steps=64, ctx=512):
+    """Steady-state decode: max_requests tokens per step at depth ``ctx``."""
+    import jax
+
+    from flexflow_tpu.serve.batch_config import BatchConfig
+
+    im = build_im(use_pallas)
+    n = im.max_requests
+    rng = np.random.RandomState(0)
+
+    def bc_at(depth):
+        return BatchConfig.build(
+            rng.randint(1, 31999, size=n).tolist(),
+            list(range(n)),
+            [depth] * n,
+            [depth + 1] * n,
+            max_tokens=n,
+            max_requests=n,
+        )
+
+    result = im.step(bc_at(ctx))  # warmup / compile
+    jax.block_until_ready(result.token_ids)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        result = im.step(bc_at(ctx + 1 + i))
+    jax.block_until_ready(result.token_ids)
+    dt = time.perf_counter() - t0
+    return steps * n / dt, dt / steps * 1e3  # tokens/sec, ms/step (TPOT)
 
 
 def bench_mlp_train(steps: int = 50, batch: int = 64):
@@ -22,7 +86,7 @@ def bench_mlp_train(steps: int = 50, batch: int = 64):
     x = model.create_tensor((batch, 784))
     h = model.dense(x, 512, activation="relu")
     h = model.dense(h, 512, activation="relu")
-    out = model.softmax(model.dense(h, 10))
+    model.softmax(model.dense(h, 10))
     model.compile(optimizer=SGDOptimizer(lr=0.05, momentum=0.9))
 
     rng = np.random.RandomState(0)
@@ -32,67 +96,31 @@ def bench_mlp_train(steps: int = 50, batch: int = 64):
     xb, yb = jnp.asarray(X), jnp.asarray(y)
     key = jax.random.PRNGKey(0)
 
-    # warmup/compile
     p, s = model.params, model.opt_state
     p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
     jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
-    for i in range(steps):
+    for _ in range(steps):
         p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     return steps * batch / dt
 
 
-def bench_baseline_unjitted(steps: int = 10, batch: int = 64):
-    """Layer-by-layer eager JAX: what per-op dispatch (the reference's
-    task-per-op model) costs without whole-graph compilation."""
-    import jax
-    import jax.numpy as jnp
-
-    rng = jax.random.PRNGKey(0)
-    k1, k2, k3 = jax.random.split(rng, 3)
-    w1 = jax.random.normal(k1, (784, 512)) * 0.05
-    w2 = jax.random.normal(k2, (512, 512)) * 0.05
-    w3 = jax.random.normal(k3, (512, 10)) * 0.05
-    b1 = jnp.zeros(512)
-    b2 = jnp.zeros(512)
-    b3 = jnp.zeros(10)
-    params = [w1, b1, w2, b2, w3, b3]
-    X = jnp.asarray(np.random.RandomState(0).randn(batch, 784), jnp.float32)
-    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, batch))
-
-    def loss_fn(params):
-        w1, b1, w2, b2, w3, b3 = params
-        h = jnp.maximum(X @ w1 + b1, 0)
-        h = jnp.maximum(h @ w2 + b2, 0)
-        logits = h @ w3 + b3
-        logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
-
-    grad_fn = jax.grad(loss_fn)  # eager, not jitted
-    g = grad_fn(params)
-    jax.block_until_ready(g)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        g = grad_fn(params)
-        params = [p - 0.05 * gi for p, gi in zip(params, g)]
-    jax.block_until_ready(params[0])
-    dt = time.perf_counter() - t0
-    return steps * batch / dt
-
-
 def main():
-    ours = bench_mlp_train()
-    base = bench_baseline_unjitted()
+    pallas_tps, pallas_tpot = bench_decode(use_pallas=True)
+    gather_tps, _ = bench_decode(use_pallas=False)
+    mlp = bench_mlp_train()
     print(
         json.dumps(
             {
-                "metric": "mnist_mlp_train_throughput",
-                "value": round(ours, 1),
-                "unit": "samples/sec",
-                "vs_baseline": round(ours / base, 3),
+                "metric": "serve_decode_throughput",
+                "value": round(pallas_tps, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(pallas_tps / gather_tps, 3),
+                "tpot_ms": round(pallas_tpot, 3),
+                "mnist_mlp_train_samples_per_sec": round(mlp, 1),
             }
         )
     )

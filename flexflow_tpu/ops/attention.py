@@ -84,18 +84,33 @@ class MultiHeadAttention(Op):
             k = k + params["bk"]
             v = v + params["bv"]
         scale = 1.0 / np.sqrt(self.head_dim)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=acc) * scale
-        if self.causal:
-            qlen, klen = scores.shape[-2], scores.shape[-1]
-            mask = jnp.tril(jnp.ones((qlen, klen), bool))
-            scores = jnp.where(mask, scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if self.dropout > 0 and ctx.training and ctx.rng is not None:
-            keep = jax.random.bernoulli(ctx.rng, 1 - self.dropout, probs.shape)
-            probs = jnp.where(keep, probs / (1 - self.dropout), 0)
-        ctx_v = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
-                           preferred_element_type=acc)
+        seq_axes = tuple(ctx.config.get("sequence", ())) if ctx.config else ()
+        if seq_axes and ctx.mode == "local" and ctx.mesh is not None:
+            # sequence parallelism: inputs are per-shard seq blocks; run
+            # ring attention over the ICI ring instead of full-seq softmax
+            from ..parallel.ring_attention import ring_attention
+
+            (axis,) = seq_axes  # ring rotation needs a single mesh axis
+            ctx_v = ring_attention(
+                q.astype(self.dtype), k.astype(self.dtype),
+                v.astype(self.dtype), axis,
+                dict(ctx.mesh.shape)[axis], causal=self.causal, scale=scale,
+            ).astype(acc)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=acc) * scale
+            if self.causal:
+                qlen, klen = scores.shape[-2], scores.shape[-1]
+                mask = jnp.tril(jnp.ones((qlen, klen), bool))
+                scores = jnp.where(mask, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if self.dropout > 0 and ctx.training and ctx.rng is not None:
+                keep = jax.random.bernoulli(
+                    ctx.rng, 1 - self.dropout, probs.shape
+                )
+                probs = jnp.where(keep, probs / (1 - self.dropout), 0)
+            ctx_v = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                               preferred_element_type=acc)
         out = jnp.einsum("bqhd,hde->bqe", ctx_v, params["wo"],
                          preferred_element_type=acc)
         if self.use_bias:
@@ -104,23 +119,43 @@ class MultiHeadAttention(Op):
         return [out.astype(self.dtype)]
 
     def parallel_dims(self, in_specs):
-        return {"sample": in_specs[0].shape[0], "head": self.num_heads}
+        return {
+            "sample": in_specs[0].shape[0],
+            "head": self.num_heads,
+            "sequence": in_specs[0].shape[1] if in_specs[0].ndim > 2 else 1,
+        }
 
     def apply_config(self, config, in_specs, mesh, in_shardings=None):
         q, k, v = in_specs
         sample = tuple(config.get("sample", ()))
         head = tuple(config.get("head", ()))
+        seq = tuple(config.get("sequence", ()))
+        if seq and len(seq) != 1:
+            raise ValueError("sequence parallelism uses exactly one mesh axis")
+        if seq and (self.dropout or 0) > 0:
+            raise ValueError("sequence parallelism + attention dropout "
+                             "is not supported")
+        if seq and q.shape[1] != k.shape[1]:
+            raise ValueError(
+                "sequence parallelism requires equal q/k sequence lengths "
+                f"(got {q.shape[1]} vs {k.shape[1]}); ring attention rotates "
+                "same-size blocks"
+            )
 
         def in_sh(spec):
             sh = TensorSharding.replicated(spec.ndim)
             if sample:
                 sh = sh.with_dim(0, sample)
+            if seq:
+                sh = sh.with_dim(1, seq)
             return sh
 
         out = self.infer_shapes([q, k, v])[0]
         out_sh = TensorSharding.replicated(out.ndim)
         if sample:
             out_sh = out_sh.with_dim(0, sample)
+        if seq:
+            out_sh = out_sh.with_dim(1, seq)
         if head:
             out_sh = out_sh.with_partial(head)
 

@@ -141,13 +141,10 @@ def decode_attention(
     t, qh, d = q.shape
     _, s_len, num_kv, _ = k_cache.shape
     gq = qh // num_kv
-    if s_len % block_s:
-        # shrink to the largest divisor of s_len <= block_s so blocks stay
-        # VMEM-sized (growing to s_len could blow the ~16MB VMEM budget)
-        block_s = next(
-            b for b in range(min(block_s, s_len), 0, -1) if s_len % b == 0
-        )
-    n_blocks = s_len // block_s
+    block_s = min(block_s, s_len)
+    # non-dividing tails are fine: the grid rounds up and the causal mask
+    # (key_pos <= pos, with pos < s_len) discards the padded region
+    n_blocks = pl.cdiv(s_len, block_s)
     if slopes is None:
         slopes = jnp.zeros((qh,), jnp.float32)
     slopes = jnp.broadcast_to(slopes.astype(jnp.float32)[None, :], (1, qh))

@@ -1,0 +1,133 @@
+"""Pipeline parallelism: explicit GPipe microbatch schedule over a mesh axis.
+
+The reference has NO pipeline schedule engine (SURVEY.md §2.3: Legion's async
+tasking gives only implicit cross-iteration pipelining), so this is a
+capability the TPU rebuild adds outright.  Design: homogeneous stages laid
+out along a ``pp`` mesh axis; stage parameters are stacked on a leading stage
+dimension and sharded over the axis; activations hop stage→stage via
+``ppermute``; a static-length loop runs the classic GPipe fill/steady/drain
+schedule.  Reverse-mode autodiff through the loop (ppermute transposes to the
+reverse rotation) yields the backward pipeline automatically — no hand-built
+1F1B needed for correctness; the schedule is still bubble-bounded like GPipe.
+
+Runs inside ``shard_map`` (explicit-collective layer, like ring attention).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_micro: jax.Array,   # [n_micro, ...mb...] microbatched input
+    axis_name: str,
+    n_stages: int,
+    broadcast: bool = True,
+) -> jax.Array:
+    """Run ``n_stages`` pipelined applications of ``stage_fn``.
+
+    ``stage_params``: pytree whose leaves carry this shard's stage slice with
+    a leading stage dim of 1 (i.e. globally ``[n_stages, ...]`` sharded over
+    ``axis_name``).  ``stage_fn(params, x) -> y`` must preserve the
+    activation shape (homogeneous pipeline).  Returns ``[n_micro, ...]``
+    outputs of the final stage, broadcast to every shard — or, with
+    ``broadcast=False``, each shard's LOCAL buffer (only valid on the last
+    stage; use this under autodiff and mask the loss instead, because the
+    psum broadcast would multiply cotangents by ``n_stages`` when every
+    shard evaluates the loss).
+    """
+    idx = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    total = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    mb_shape = x_micro.shape[1:]
+
+    def body(t, carry):
+        state, outputs = carry
+        feed = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        x_in = jnp.where(idx == 0, feed, state)
+        y = stage_fn(params, x_in)
+        oi = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        cur = lax.dynamic_index_in_dim(outputs, oi, 0, keepdims=False)
+        # only the LAST stage materializes outputs: under per-shard autodiff
+        # seeding, intermediate stages' buffers would otherwise feed their
+        # (garbage) local losses and corrupt gradients
+        keep = (t >= n_stages - 1) & (idx == n_stages - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(keep, y, cur), oi, 0
+        )
+        state = lax.ppermute(y, axis_name, perm)
+        return state, outputs
+
+    state0 = jnp.zeros(mb_shape, x_micro.dtype)
+    out0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    _, outputs = lax.fori_loop(0, total, body, (state0, out0), unroll=False)
+    if not broadcast:
+        return outputs
+    # only the last stage holds real outputs; broadcast them to every shard
+    outputs = jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_train_step(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh,
+    axis_name: str = "pp",
+    dp_axis: str | None = None,
+):
+    """Build a shard_map'd (loss, grads) function for a pipelined model.
+
+    ``stage_fn(params, x) -> y``; ``loss_fn(y, labels) -> scalar`` applied to
+    final-stage outputs (mean over microbatches).  Global arrays in/out:
+    ``stacked_params [n_stages, ...]``, ``x [n_micro, mb, ...]``, ``labels``
+    aligned with ``x``.  Batch-dim data parallelism composes by also sharding
+    the microbatch dim over ``dp_axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = dict(mesh.shape)[axis_name]
+
+    def local_step(stacked_params, x, labels):
+        def loss_of(params_):
+            outs = pipeline_apply(
+                stage_fn, params_, x, axis_name, n_stages, broadcast=False
+            )
+            # LOCAL loss only — no collective inside the differentiated
+            # function: every shard seeds its own scalar with 1, so a psum
+            # here would transpose to an n_stages-fold cotangent.  Non-last
+            # shards' losses are garbage but carry no param dependence
+            # (their outputs buffer stays zero).
+            return loss_fn(outs, labels)
+
+        loss, grads = jax.value_and_grad(loss_of)(stacked_params)
+        # replicate the real (last-stage) loss for reporting
+        last = lax.axis_index(axis_name) == n_stages - 1
+        loss = lax.psum(jnp.where(last, loss, 0.0), axis_name)
+        if dp_axis is not None:
+            loss = lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: lax.pmean(g, dp_axis), grads)
+        return loss, grads
+
+    data_spec = P(None, dp_axis) if dp_axis else P()
+
+    def step(stacked_params, x, labels):
+        p_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(p_specs, data_spec, data_spec),
+            out_specs=(P(), p_specs),
+            check_vma=False,
+        )(stacked_params, x, labels)
+
+    return step

@@ -1,0 +1,78 @@
+"""Ring attention: sequence-parallel attention over the ICI ring.
+
+Beyond-reference extension (SURVEY.md §2.3: the reference has NO sequence/
+context parallelism — long-context scaling is a TPU-native win).  Sequence is
+sharded over a mesh axis; each device holds a Q/K/V block and K/V blocks
+rotate around the ring via ``ppermute`` while a blockwise online softmax
+accumulates — compute overlaps communication, memory per device is
+O(T/n · T/n) per step instead of O(T²).
+
+Runs inside ``shard_map`` (the interpreter's "local" mode): arrays here are
+per-shard blocks, collectives are explicit — exactly the layer the PCG's
+parallel ops are costed at.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,  # [B, T_loc, H, D] — this shard's query block
+    k: jax.Array,  # [B, T_loc, H, D]
+    v: jax.Array,  # [B, T_loc, H, D]
+    axis_name: str,
+    n_shards: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard attention output [B, T_loc, H, D] (pre-output-projection)."""
+    b, t_loc, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    idx = lax.axis_index(axis_name)
+    qpos = idx * t_loc + jnp.arange(t_loc)
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, t_loc, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t_loc, 1), jnp.float32)
+    acc = jnp.zeros((b, t_loc, h, d), jnp.float32)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(step, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - step) % n_shards  # which shard this K/V block came from
+        kpos = src * t_loc + jnp.arange(t_loc)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            mask = kpos[None, :] <= qpos[:, None]
+            scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        if causal:  # fully-masked rows: keep p exactly zero
+            p = jnp.where(mask[None, None, :, :], p, 0.0)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * jnp.moveaxis(alpha, 1, 2) + pv
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m_new, l, acc
+
+    _, _, m, l, acc = lax.fori_loop(0, n_shards, body, (k, v, m, l, acc))
+    denom = jnp.maximum(jnp.moveaxis(l, 1, 2), 1e-30)
+    return (acc / denom).astype(q.dtype)

@@ -39,6 +39,7 @@ def ref_attention(q, kc, vc, rows, pos, scale, slopes=None):
     (4, 2, 8, 32, 16),    # GQA
     (4, 4, 8, 32, 32),    # MHA, single block
     (8, 1, 16, 64, 16),   # MQA
+    (4, 2, 8, 40, 16),    # non-dividing seq len -> padded tail block
 ])
 def test_kernel_matches_reference(qh, kv, d, s, block):
     rng = np.random.default_rng(0)
