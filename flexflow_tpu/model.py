@@ -256,6 +256,41 @@ class FFModel:
     def beam_top_k(self, x, max_beam_width, name=None):
         return self._add(BeamTopK(max_beam_width), [x], name or "beam_top_k")
 
+    # mixture of experts (reference: group_by/experts/aggregate ops +
+    # examples/cpp/mixture_of_experts)
+    def group_by(self, x, gates, num_experts, k=1, capacity_factor=1.25,
+                 name=None):
+        from .ops.moe import GroupBy
+
+        op = GroupBy(num_experts, k, capacity_factor)
+        return self._add(op, [x, gates], name or "group_by")
+
+    def experts(self, dispatched, out_dim, hidden_dim=None, activation="relu",
+                name=None):
+        from .ops.moe import Experts
+
+        op = Experts(out_dim, hidden_dim, activation, dtype=dispatched.dtype)
+        return self._add(op, [dispatched], name or "experts")[0]
+
+    def aggregate(self, expert_out, combine, name=None):
+        from .ops.moe import Aggregate
+
+        return self._add(Aggregate(), [expert_out, combine],
+                         name or "aggregate")[0]
+
+    def moe_layer(self, x, num_experts, out_dim, hidden_dim=None, k=1,
+                  capacity_factor=1.25, activation="relu", name=None):
+        """Router (dense+softmax) -> group_by -> experts -> aggregate."""
+        name = name or "moe"
+        gates = self.softmax(
+            self.dense(x, num_experts, use_bias=False, name=f"{name}.router")
+        )
+        disp, comb = self.group_by(x, gates, num_experts, k, capacity_factor,
+                                   name=f"{name}.group_by")
+        eo = self.experts(disp, out_dim, hidden_dim, activation,
+                          name=f"{name}.experts")
+        return self.aggregate(eo, comb, name=f"{name}.aggregate")
+
     # attention (serving): KV-cached / speculative / tree-verify variants.
     # Reference: FFModel::inc_multihead_self_attention and friends in
     # src/runtime/model.cc; these require running under the InferenceManager

@@ -1,0 +1,46 @@
+"""Mixture-of-Experts MLP — parity with ``examples/cpp/mixture_of_experts``.
+
+Reference: the MoE example stacks an MLP whose middle layer routes through
+``group_by -> experts -> aggregate``; here the same graph comes from
+``FFModel.moe_layer`` with fixed-capacity dispatch (see ops/moe.py), and
+expert parallelism is one strategy entry away.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_moe_classifier(
+    config: Optional[FFConfig] = None,
+    mesh=None,
+    batch: int = 32,
+    in_dim: int = 64,
+    num_experts: int = 4,
+    expert_hidden: int = 128,
+    num_classes: int = 10,
+    k: int = 2,
+    capacity_factor: float = 2.0,
+    ep_axes=(),
+    dp_axes=(),
+):
+    """Returns (FFModel, input_tensor, output_tensor, strategy)."""
+    ff = FFModel(config or FFConfig(batch_size=batch), mesh=mesh)
+    x_in = ff.create_tensor((batch, in_dim))
+    h = ff.dense(x_in, in_dim, activation="relu", name="pre")
+    h = ff.moe_layer(
+        h, num_experts, in_dim, hidden_dim=expert_hidden, k=k,
+        capacity_factor=capacity_factor, name="moe",
+    )
+    out = ff.softmax(ff.dense(h, num_classes, name="head"))
+    strategy = {}
+    if ep_axes:
+        for node in ("moe.group_by", "moe.experts", "moe.aggregate"):
+            strategy[node] = {"expert": ep_axes}
+    if dp_axes:
+        for node in ("pre", "head"):
+            strategy[node] = {"sample": dp_axes}
+    return ff, x_in, out, strategy

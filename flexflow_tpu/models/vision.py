@@ -1,0 +1,103 @@
+"""Vision model zoo: AlexNet, ResNet, Inception blocks.
+
+Reference: ``examples/cpp/AlexNet/alexnet.cc``, ``ResNet/resnet.cc``,
+``InceptionV3/inception.cc`` — the cuDNN conv stacks the reference trains as
+examples.  NCHW graphs through Conv2D/Pool2D/BatchNorm; XLA:TPU re-lays-out
+for the MXU's convolution path on its own.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_alexnet(config=None, mesh=None, batch=4, num_classes=10,
+                  image=(3, 64, 64)):
+    """AlexNet-style stack (scaled to the configured image size)."""
+    ff = FFModel(config or FFConfig(batch_size=batch), mesh=mesh)
+    x_in = ff.create_tensor((batch,) + tuple(image))
+    x = ff.conv2d(x_in, 64, kernel=(11, 11), stride=(4, 4), padding="SAME",
+                  activation="relu", name="conv1")
+    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="pool1")
+    x = ff.conv2d(x, 192, kernel=(5, 5), activation="relu", name="conv2")
+    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="pool2")
+    x = ff.conv2d(x, 384, activation="relu", name="conv3")
+    x = ff.conv2d(x, 256, activation="relu", name="conv4")
+    x = ff.conv2d(x, 256, activation="relu", name="conv5")
+    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="pool5")
+    x = ff.flat(x, name="flat")
+    x = ff.dense(x, 512, activation="relu", name="fc6")
+    x = ff.dense(x, 512, activation="relu", name="fc7")
+    out = ff.softmax(ff.dense(x, num_classes, name="fc8"))
+    return ff, x_in, out
+
+
+def _res_block(ff, x, channels, stride, name):
+    """Basic ResNet block: conv-bn-relu, conv-bn, shortcut add, relu."""
+    h = ff.conv2d(x, channels, stride=(stride, stride), use_bias=False,
+                  name=f"{name}.conv1")
+    h = ff.batch_norm(h, relu=True, name=f"{name}.bn1")
+    h = ff.conv2d(h, channels, use_bias=False, name=f"{name}.conv2")
+    h = ff.batch_norm(h, name=f"{name}.bn2")
+    if stride != 1 or x.shape[1] != channels:
+        x = ff.conv2d(x, channels, kernel=(1, 1), stride=(stride, stride),
+                      use_bias=False, name=f"{name}.short")
+        x = ff.batch_norm(x, name=f"{name}.short_bn")
+    return ff.relu(ff.add(h, x, name=f"{name}.add"), name=f"{name}.out")
+
+
+def build_resnet18(config=None, mesh=None, batch=4, num_classes=10,
+                   image=(3, 64, 64)):
+    ff = FFModel(config or FFConfig(batch_size=batch), mesh=mesh)
+    x_in = ff.create_tensor((batch,) + tuple(image))
+    x = ff.conv2d(x_in, 64, kernel=(7, 7), stride=(2, 2), use_bias=False,
+                  name="stem.conv")
+    x = ff.batch_norm(x, relu=True, name="stem.bn")
+    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="stem.pool")
+    for stage, (ch, stride) in enumerate([(64, 1), (128, 2), (256, 2),
+                                          (512, 2)]):
+        for blk in range(2):
+            x = _res_block(ff, x, ch, stride if blk == 0 else 1,
+                           f"layer{stage + 1}.{blk}")
+    x = ff.pool2d(x, kernel=x.shape[2:], stride=(1, 1), pool_type="avg",
+                  name="gap")
+    x = ff.flat(x, name="flat")
+    out = ff.softmax(ff.dense(x, num_classes, name="fc"))
+    return ff, x_in, out
+
+
+def _inception_block(ff, x, c1, c3r, c3, c5r, c5, cp, name):
+    """GoogLeNet-style mixed block: 1x1 | 1x1-3x3 | 1x1-5x5 | pool-1x1."""
+    b1 = ff.conv2d(x, c1, kernel=(1, 1), activation="relu", name=f"{name}.b1")
+    b3 = ff.conv2d(x, c3r, kernel=(1, 1), activation="relu",
+                   name=f"{name}.b3r")
+    b3 = ff.conv2d(b3, c3, kernel=(3, 3), activation="relu", name=f"{name}.b3")
+    b5 = ff.conv2d(x, c5r, kernel=(1, 1), activation="relu",
+                   name=f"{name}.b5r")
+    b5 = ff.conv2d(b5, c5, kernel=(5, 5), activation="relu", name=f"{name}.b5")
+    bp = ff.pool2d(x, kernel=(3, 3), stride=(1, 1), padding="SAME",
+                   name=f"{name}.pool")
+    bp = ff.conv2d(bp, cp, kernel=(1, 1), activation="relu", name=f"{name}.bp")
+    return ff.concat([b1, b3, b5, bp], axis=1, name=f"{name}.cat")
+
+
+def build_inception(config=None, mesh=None, batch=4, num_classes=10,
+                    image=(3, 64, 64)):
+    """Compact Inception: stem + two mixed blocks + head (InceptionV3's
+    graph shape — parallel branches merged by channel concat — at example
+    scale)."""
+    ff = FFModel(config or FFConfig(batch_size=batch), mesh=mesh)
+    x_in = ff.create_tensor((batch,) + tuple(image))
+    x = ff.conv2d(x_in, 32, stride=(2, 2), activation="relu", name="stem1")
+    x = ff.conv2d(x, 64, activation="relu", name="stem2")
+    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="stem_pool")
+    x = _inception_block(ff, x, 64, 48, 64, 8, 16, 32, "mixed0")
+    x = _inception_block(ff, x, 64, 48, 64, 8, 16, 32, "mixed1")
+    x = ff.pool2d(x, kernel=x.shape[2:], stride=(1, 1), pool_type="avg",
+                  name="gap")
+    x = ff.flat(x, name="flat")
+    out = ff.softmax(ff.dense(x, num_classes, name="fc"))
+    return ff, x_in, out

@@ -1,0 +1,235 @@
+"""Mixture-of-Experts ops: GroupBy / Experts / Aggregate (+ router TopK).
+
+Reference: ``src/ops/group_by.cu``, ``experts.cc``, ``aggregate.cu``,
+``aggregate_spec.cu`` and ``examples/cpp/mixture_of_experts`` — the reference
+physically partitions samples into per-expert tensors with data-dependent
+sizes (CUDA tolerates ragged work).  TPU re-design: **fixed-capacity
+dispatch** (GShard/Mixtral style) so every shape is static:
+
+* :class:`GroupBy` — top-k routing against gate probabilities, one-hot
+  dispatch into ``[E, C, d]`` (capacity ``C = ceil(k*N/E * capacity_factor)``;
+  overflow tokens are dropped, like the reference's ``alpha`` capacity knob).
+* :class:`Experts` — batched per-expert FFN on ``[E, C, d]``: ONE einsum over
+  the expert dim feeds the MXU; expert parallelism = shard dim 0 over the
+  ``expert`` mesh axes, and with tokens sample-sharded GSPMD lowers the
+  dispatch/combine einsums to the ``all_to_all`` over ICI.
+* :class:`Aggregate` — combine expert outputs back to token order, weighted
+  by gate probabilities.  (``AggregateSpec``'s speculative variant is
+  subsumed by the serve tree machinery and not needed here.)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import ParamSpec, TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+
+
+def moe_capacity(n_tokens: int, n_experts: int, k: int,
+                 capacity_factor: float) -> int:
+    return max(1, int(math.ceil(k * n_tokens / n_experts * capacity_factor)))
+
+
+@register_op
+class GroupBy(Op):
+    """(x [N, d], gates [N, E]) -> dispatched [E, C, d], combine [N, E, C]."""
+
+    type_name = "group_by"
+
+    def __init__(self, num_experts: int, k: int = 1,
+                 capacity_factor: float = 1.25):
+        self.num_experts = int(num_experts)
+        self.k = int(k)
+        self.capacity_factor = float(capacity_factor)
+
+    def _cap(self, n_tokens: int) -> int:
+        return moe_capacity(n_tokens, self.num_experts, self.k,
+                            self.capacity_factor)
+
+    def infer_shapes(self, in_specs):
+        x, gates = in_specs
+        if gates.shape[-1] != self.num_experts:
+            raise ValueError(
+                f"gates last dim {gates.shape[-1]} != num_experts "
+                f"{self.num_experts}"
+            )
+        n, d = x.shape
+        c = self._cap(n)
+        return [
+            TensorSpec((self.num_experts, c, d), x.dtype),
+            TensorSpec((n, self.num_experts, c), jnp.float32),
+        ]
+
+    def lower(self, ctx, inputs, params):
+        x, gates = inputs
+        n, d = x.shape
+        e, k = self.num_experts, self.k
+        c = self._cap(n)
+        topv, topi = jax.lax.top_k(gates, k)               # [N, k]
+        # position of each (token, choice) within its expert's capacity:
+        # rank = #tokens with the same expert before me (token-order policy,
+        # matching the reference's first-come group_by fill)
+        onehot = jax.nn.one_hot(topi, e, dtype=jnp.int32)  # [N, k, E]
+        flat = onehot.reshape(n * k, e)
+        rank = jnp.cumsum(flat, axis=0) - flat             # [N*k, E]
+        rank = jnp.sum(rank * flat, axis=-1).reshape(n, k)  # [N, k]
+        keep = rank < c                                    # overflow dropped
+        # dispatch mask [N, E, C]
+        pos_onehot = jax.nn.one_hot(jnp.where(keep, rank, c), c + 1,
+                                    dtype=x.dtype)[..., :c]  # [N, k, C]
+        disp = jnp.einsum("nke,nkc->nec", onehot.astype(x.dtype), pos_onehot)
+        dispatched = jnp.einsum("nec,nd->ecd", disp, x)
+        combine = disp.astype(jnp.float32) * jnp.einsum(
+            "nke,nk->ne", onehot.astype(jnp.float32),
+            topv.astype(jnp.float32) * keep.astype(jnp.float32),
+        )[..., None]
+        return [dispatched, combine]
+
+    def parallel_dims(self, in_specs):
+        return {"sample": in_specs[0].shape[0],
+                "expert": self.num_experts}
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        x, gates = in_specs
+        expert = tuple(config.get("expert", ()))
+        x_sh = TensorSharding.replicated(2)
+        g_sh = TensorSharding.replicated(2)
+        out0 = TensorSharding.replicated(3)
+        out1 = TensorSharding.replicated(3)
+        if expert:
+            out0 = out0.with_dim(0, expert)   # dispatched: expert-sharded
+            out1 = out1.with_dim(1, expert)
+        return ShardingSolution(inputs=[x_sh, g_sh], outputs=[out0, out1])
+
+    def flops(self, in_specs):
+        x, _ = in_specs
+        n, d = x.shape
+        c = self._cap(n)
+        return 2 * n * self.num_experts * c * (d + 1)
+
+
+@register_op
+class Experts(Op):
+    """Batched per-expert FFN: [E, C, d] -> [E, C, out].
+
+    Reference: ``src/ops/experts.cc`` (batched expert GEMMs).  One einsum —
+    the expert dim is a batch dim of an MXU matmul, and the natural expert-
+    parallel shard dim.
+    """
+
+    type_name = "experts"
+
+    def __init__(self, out_dim: int, hidden_dim: Optional[int] = None,
+                 activation: str = "relu", dtype=jnp.float32):
+        self.out_dim = int(out_dim)
+        self.hidden_dim = int(hidden_dim) if hidden_dim else None
+        self.activation = activation
+        self.dtype = jnp.dtype(dtype).name
+        self.num_experts = None  # bound at first infer_shapes
+        self.in_dim = None
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        e, c, d = x.shape
+        if self.num_experts is None:
+            self.num_experts, self.in_dim = e, d
+        return [TensorSpec((e, c, self.out_dim), jnp.dtype(self.dtype))]
+
+    def params(self) -> List[ParamSpec]:
+        d = jnp.dtype(self.dtype)
+        e, din = self.num_experts, self.in_dim
+        if self.hidden_dim:
+            return [
+                ParamSpec("w1", TensorSpec((e, din, self.hidden_dim), d)),
+                ParamSpec("b1", TensorSpec((e, self.hidden_dim), d)),
+                ParamSpec("w2", TensorSpec((e, self.hidden_dim, self.out_dim), d)),
+                ParamSpec("b2", TensorSpec((e, self.out_dim), d)),
+            ]
+        return [
+            ParamSpec("w1", TensorSpec((e, din, self.out_dim), d)),
+            ParamSpec("b1", TensorSpec((e, self.out_dim), d)),
+        ]
+
+    def lower(self, ctx, inputs, params):
+        from .elementwise import UNARY_FNS
+
+        x = inputs[0]
+        h = jnp.einsum("ecd,edh->ech", x, params["w1"],
+                       preferred_element_type=jnp.float32) + params["b1"]
+        if self.hidden_dim:
+            h = UNARY_FNS[self.activation](h)
+            h = jnp.einsum("ech,eho->eco", h.astype(x.dtype), params["w2"],
+                           preferred_element_type=jnp.float32) + params["b2"]
+        return [h.astype(self.dtype)]
+
+    def parallel_dims(self, in_specs):
+        return {"expert": in_specs[0].shape[0]}
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        expert = tuple(config.get("expert", ()))
+        x_sh = TensorSharding.replicated(3)
+        out_sh = TensorSharding.replicated(3)
+        params = {}
+        if expert:
+            x_sh = x_sh.with_dim(0, expert)
+            out_sh = out_sh.with_dim(0, expert)
+        for p in self.params():
+            sh = TensorSharding.replicated(p.spec.ndim)
+            if expert:
+                sh = sh.with_dim(0, expert)
+            params[p.name] = sh
+        return ShardingSolution(inputs=[x_sh], outputs=[out_sh], params=params)
+
+    def flops(self, in_specs):
+        e, c, d = in_specs[0].shape
+        if self.hidden_dim:
+            return 2 * e * c * (d * self.hidden_dim
+                                + self.hidden_dim * self.out_dim)
+        return 2 * e * c * d * self.out_dim
+
+
+@register_op
+class Aggregate(Op):
+    """(expert_out [E, C, d], combine [N, E, C]) -> [N, d].
+
+    Reference: ``src/ops/aggregate.cu`` — gate-weighted scatter back to
+    token order; here a single einsum (the all_to_all's return leg under EP).
+    """
+
+    type_name = "aggregate"
+
+    def infer_shapes(self, in_specs):
+        eo, comb = in_specs
+        return [TensorSpec((comb.shape[0], eo.shape[-1]), eo.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        eo, comb = inputs
+        out = jnp.einsum("ecd,nec->nd", eo.astype(jnp.float32),
+                         comb.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return [out.astype(eo.dtype)]
+
+    def parallel_dims(self, in_specs):
+        return {"expert": in_specs[0].shape[0]}
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        expert = tuple(config.get("expert", ()))
+        eo_sh = TensorSharding.replicated(3)
+        comb_sh = TensorSharding.replicated(3)
+        out_sh = TensorSharding.replicated(2)
+        if expert:
+            eo_sh = eo_sh.with_dim(0, expert)
+            comb_sh = comb_sh.with_dim(1, expert)
+            out_sh = out_sh.with_partial(expert)
+        return ShardingSolution(inputs=[eo_sh, comb_sh], outputs=[out_sh])
+
+    def flops(self, in_specs):
+        eo, comb = in_specs
+        return 2 * int(np.prod(comb.shape)) * eo.shape[-1]

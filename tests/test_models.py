@@ -1,0 +1,170 @@
+"""Model zoo (training side): MoE, DLRM, vision — graph + parallel tests.
+
+Reference test strategy (SURVEY.md §4): the examples double as tests — build,
+train a step or two, check loss falls / outputs sane.  Plus hermetic EP/MP
+sharding equivalence on the virtual mesh, which the reference cannot do.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.models.dlrm import build_dlrm
+from flexflow_tpu.models.moe import build_moe_classifier
+from flexflow_tpu.models.vision import (
+    build_alexnet,
+    build_inception,
+    build_resnet18,
+)
+from flexflow_tpu.parallel.mesh import make_mesh
+
+
+# ---------------------------------------------------------------------------
+# MoE
+# ---------------------------------------------------------------------------
+def test_moe_single_expert_equals_dense():
+    # E=1, k=1, capacity >= N: routing is the identity, so the MoE layer
+    # must equal its expert MLP exactly (gate prob = softmax over 1 = 1.0)
+    batch, d = 8, 16
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    ff = FFModel(FFConfig(batch_size=batch), mesh=mesh)
+    x_in = ff.create_tensor((batch, d))
+    out = ff.moe_layer(x_in, num_experts=1, out_dim=d, hidden_dim=32,
+                       capacity_factor=float(batch), name="moe")
+    ff.compile(outputs=[out], loss_type="identity")
+    x = np.random.RandomState(0).randn(batch, d).astype(np.float32)
+    got = np.asarray(ff.forward(x))
+
+    p = ff.params["moe.experts"]
+    h = np.maximum(x @ np.asarray(p["w1"])[0] + np.asarray(p["b1"])[0], 0)
+    want = h @ np.asarray(p["w2"])[0] + np.asarray(p["b2"])[0]
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_moe_capacity_drops_overflow():
+    # all tokens route to one expert with tiny capacity: output must stay
+    # finite and the dropped tokens contribute zeros (combine weight 0)
+    from flexflow_tpu.ops.moe import GroupBy
+
+    n, d, e = 8, 4, 2
+    x = jnp.asarray(np.random.RandomState(0).randn(n, d), jnp.float32)
+    gates = jnp.tile(jnp.asarray([[0.9, 0.1]]), (n, 1))
+    op = GroupBy(e, k=1, capacity_factor=0.25)  # capacity = 1
+    from flexflow_tpu.core.op import OpContext
+
+    disp, comb = op.lower(OpContext(), [x, gates], {})
+    assert disp.shape == (e, 1, d)
+    # only token 0 kept for expert 0; combine rows for tokens 1.. are zero
+    np.testing.assert_allclose(np.asarray(disp[0, 0]), np.asarray(x[0]),
+                               atol=1e-6)
+    assert float(jnp.sum(comb[1:])) == 0.0
+
+
+def test_moe_expert_parallel_matches_single_device():
+    batch = 16
+    common = dict(batch=batch, in_dim=8, num_experts=4, expert_hidden=16,
+                  num_classes=6, k=2, capacity_factor=4.0)
+    x = np.random.RandomState(1).randn(batch, 8).astype(np.float32)
+
+    mesh1 = make_mesh({"ep": 1}, jax.devices()[:1])
+    ff1, _, out1, strat1 = build_moe_classifier(mesh=mesh1, **common)
+    ff1.compile(outputs=[out1], strategy=strat1, loss_type="identity")
+
+    mesh4 = make_mesh({"ep": 4}, jax.devices()[:4])
+    ff4, _, out4, strat4 = build_moe_classifier(mesh=mesh4, ep_axes=("ep",),
+                                                **common)
+    ff4.compile(outputs=[out4], strategy=strat4, loss_type="identity")
+
+    for node, sub in ff1.params.items():
+        for pname, arr in sub.items():
+            np.testing.assert_allclose(np.asarray(arr),
+                                       np.asarray(ff4.params[node][pname]))
+    np.testing.assert_allclose(np.asarray(ff1.forward(x)),
+                               np.asarray(ff4.forward(x)),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_moe_trains():
+    batch = 16
+    mesh = make_mesh({"dp": 2, "ep": 2}, jax.devices()[:4])
+    ff, _, out, strat = build_moe_classifier(
+        mesh=mesh, batch=batch, in_dim=8, num_experts=2, expert_hidden=16,
+        num_classes=4, ep_axes=("ep",), dp_axes=("dp",),
+    )
+    ff.compile(optimizer=SGDOptimizer(lr=0.1), outputs=[out], strategy=strat,
+               metrics=["accuracy"])
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    hist = ff.fit(X, y, epochs=3, batch_size=batch, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+# ---------------------------------------------------------------------------
+# DLRM
+# ---------------------------------------------------------------------------
+def test_dlrm_trains_with_sharded_tables():
+    batch = 16
+    mesh = make_mesh({"dp": 2, "mp": 2}, jax.devices()[:4])
+    ff, dense_in, sparse_ins, out, strat = build_dlrm(
+        mesh=mesh, batch=batch, dense_dim=8,
+        table_sizes=(64, 64), embed_dim=16,
+        bottom_mlp=(32, 16), top_mlp=(32, 1),
+        mp_axes=("mp",), dp_axes=("dp",),
+    )
+    ff.compile(optimizer=SGDOptimizer(lr=0.05), outputs=[out], strategy=strat,
+               loss_type="binary_crossentropy")
+    rng = np.random.RandomState(3)
+    n = 64
+    Xd = rng.randn(n, 8).astype(np.float32)
+    Xs = [rng.randint(0, 64, size=(n, 1)).astype(np.int32) for _ in range(2)]
+    y = rng.randint(0, 2, size=(n, 1)).astype(np.float32)
+    inputs = {dense_in: Xd, sparse_ins[0]: Xs[0], sparse_ins[1]: Xs[1]}
+    hist = ff.fit(inputs, y, epochs=3, batch_size=batch, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_dlrm_sharded_matches_replicated():
+    batch = 8
+    kw = dict(batch=batch, dense_dim=4, table_sizes=(32, 32), embed_dim=8,
+              bottom_mlp=(16, 8), top_mlp=(16, 1))
+    rng = np.random.RandomState(4)
+    Xd = rng.randn(batch, 4).astype(np.float32)
+    Xs = [rng.randint(0, 32, size=(batch, 1)).astype(np.int32)
+          for _ in range(2)]
+
+    mesh1 = make_mesh({"mp": 1}, jax.devices()[:1])
+    ff1, d1, s1, o1, _ = build_dlrm(mesh=mesh1, **kw)
+    ff1.compile(outputs=[o1], loss_type="identity")
+
+    mesh4 = make_mesh({"mp": 4}, jax.devices()[:4])
+    ff4, d4, s4, o4, strat = build_dlrm(mesh=mesh4, mp_axes=("mp",), **kw)
+    ff4.compile(outputs=[o4], strategy=strat, loss_type="identity")
+
+    got1 = np.asarray(ff1.forward({d1: Xd, s1[0]: Xs[0], s1[1]: Xs[1]}))
+    got4 = np.asarray(ff4.forward({d4: Xd, s4[0]: Xs[0], s4[1]: Xs[1]}))
+    np.testing.assert_allclose(got1, got4, atol=1e-5, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("builder", [build_alexnet, build_resnet18,
+                                     build_inception])
+def test_vision_models_forward_and_train(builder):
+    batch = 4
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    ff, x_in, out = builder(mesh=mesh, batch=batch, num_classes=5,
+                            image=(3, 32, 32))
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), outputs=[out],
+               metrics=["accuracy"])
+    rng = np.random.RandomState(5)
+    X = rng.randn(8, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 5, size=8).astype(np.int32)
+    logits = np.asarray(ff.forward(X[:batch]))
+    assert logits.shape == (batch, 5)
+    np.testing.assert_allclose(logits.sum(-1), 1.0, atol=1e-5)
+    hist = ff.fit(X, y, epochs=2, batch_size=batch, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
