@@ -14,6 +14,22 @@ from ..config import FFConfig
 from ..model import FFModel
 
 
+def _pool_scaled(ff, x, name, kernel=(3, 3), stride=(2, 2), **kw):
+    """Pool2D clamped to the incoming spatial dims.
+
+    The classic AlexNet kernels assume 224x224 inputs; at example-scale image
+    sizes a 3x3/s2 pool can exceed the remaining spatial extent and produce a
+    zero-size tensor.  Clamp kernel (and stride) to the input so the stack
+    stays valid at any configured image size; skip entirely at 1x1.
+    """
+    h, w = x.shape[2], x.shape[3]
+    if h <= 1 and w <= 1:
+        return x
+    kh, kw_ = min(kernel[0], h), min(kernel[1], w)
+    sh, sw = min(stride[0], kh), min(stride[1], kw_)
+    return ff.pool2d(x, kernel=(kh, kw_), stride=(sh, sw), name=name, **kw)
+
+
 def build_alexnet(config=None, mesh=None, batch=4, num_classes=10,
                   image=(3, 64, 64)):
     """AlexNet-style stack (scaled to the configured image size)."""
@@ -21,13 +37,13 @@ def build_alexnet(config=None, mesh=None, batch=4, num_classes=10,
     x_in = ff.create_tensor((batch,) + tuple(image))
     x = ff.conv2d(x_in, 64, kernel=(11, 11), stride=(4, 4), padding="SAME",
                   activation="relu", name="conv1")
-    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="pool1")
+    x = _pool_scaled(ff, x, "pool1")
     x = ff.conv2d(x, 192, kernel=(5, 5), activation="relu", name="conv2")
-    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="pool2")
+    x = _pool_scaled(ff, x, "pool2")
     x = ff.conv2d(x, 384, activation="relu", name="conv3")
     x = ff.conv2d(x, 256, activation="relu", name="conv4")
     x = ff.conv2d(x, 256, activation="relu", name="conv5")
-    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="pool5")
+    x = _pool_scaled(ff, x, "pool5")
     x = ff.flat(x, name="flat")
     x = ff.dense(x, 512, activation="relu", name="fc6")
     x = ff.dense(x, 512, activation="relu", name="fc7")
@@ -56,7 +72,7 @@ def build_resnet18(config=None, mesh=None, batch=4, num_classes=10,
     x = ff.conv2d(x_in, 64, kernel=(7, 7), stride=(2, 2), use_bias=False,
                   name="stem.conv")
     x = ff.batch_norm(x, relu=True, name="stem.bn")
-    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="stem.pool")
+    x = _pool_scaled(ff, x, "stem.pool")
     for stage, (ch, stride) in enumerate([(64, 1), (128, 2), (256, 2),
                                           (512, 2)]):
         for blk in range(2):
@@ -93,7 +109,7 @@ def build_inception(config=None, mesh=None, batch=4, num_classes=10,
     x_in = ff.create_tensor((batch,) + tuple(image))
     x = ff.conv2d(x_in, 32, stride=(2, 2), activation="relu", name="stem1")
     x = ff.conv2d(x, 64, activation="relu", name="stem2")
-    x = ff.pool2d(x, kernel=(3, 3), stride=(2, 2), name="stem_pool")
+    x = _pool_scaled(ff, x, "stem_pool")
     x = _inception_block(ff, x, 64, 48, 64, 8, 16, 32, "mixed0")
     x = _inception_block(ff, x, 64, 48, 64, 8, 16, 32, "mixed1")
     x = ff.pool2d(x, kernel=x.shape[2:], stride=(1, 1), pool_type="avg",

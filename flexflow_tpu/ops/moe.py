@@ -161,12 +161,18 @@ class Experts(Op):
         from .elementwise import UNARY_FNS
 
         x = inputs[0]
+        # biases are [E, out]; insert the capacity dim so the expert dim
+        # lines up with the activations' [E, C, out] layout
         h = jnp.einsum("ecd,edh->ech", x, params["w1"],
-                       preferred_element_type=jnp.float32) + params["b1"]
+                       preferred_element_type=jnp.float32)
+        h = h + params["b1"][:, None, :]
         if self.hidden_dim:
             h = UNARY_FNS[self.activation](h)
             h = jnp.einsum("ech,eho->eco", h.astype(x.dtype), params["w2"],
-                           preferred_element_type=jnp.float32) + params["b2"]
+                           preferred_element_type=jnp.float32)
+            h = h + params["b2"][:, None, :]
+        elif self.activation:
+            h = UNARY_FNS[self.activation](h)
         return [h.astype(self.dtype)]
 
     def parallel_dims(self, in_specs):

@@ -43,6 +43,44 @@ def test_moe_single_expert_equals_dense():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
 
 
+def test_moe_experts_bias_broadcast_e_gt_1():
+    # regression: E>1 with C != E — the [E, out] biases must broadcast over
+    # the capacity dim, adding expert e's bias to every row of slot e (a
+    # trailing-dim broadcast would crash, or silently add the wrong expert's
+    # bias when C == E)
+    from flexflow_tpu.core.op import OpContext
+    from flexflow_tpu.ops.moe import Experts
+
+    e, c, d, h, o = 3, 5, 4, 8, 6
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(e, c, d), jnp.float32)
+    op = Experts(out_dim=o, hidden_dim=h)
+    op.infer_shapes([type("S", (), {"shape": (e, c, d), "dtype": jnp.float32,
+                                    "ndim": 3})()])
+    params = {
+        "w1": jnp.asarray(rng.randn(e, d, h), jnp.float32),
+        "b1": jnp.asarray(rng.randn(e, h), jnp.float32),
+        "w2": jnp.asarray(rng.randn(e, h, o), jnp.float32),
+        "b2": jnp.asarray(rng.randn(e, o), jnp.float32),
+    }
+    (got,) = op.lower(OpContext(), [x], params)
+    for ei in range(e):
+        hh = np.maximum(np.asarray(x[ei]) @ np.asarray(params["w1"][ei])
+                        + np.asarray(params["b1"][ei]), 0)
+        want = hh @ np.asarray(params["w2"][ei]) + np.asarray(params["b2"][ei])
+        np.testing.assert_allclose(np.asarray(got[ei]), want,
+                                   atol=1e-4, rtol=1e-4)
+
+    # single-GEMM path applies the configured activation too
+    op1 = Experts(out_dim=o, hidden_dim=None, activation="relu")
+    op1.infer_shapes([type("S", (), {"shape": (e, c, d), "dtype": jnp.float32,
+                                     "ndim": 3})()])
+    p1 = {"w1": jnp.asarray(rng.randn(e, d, o), jnp.float32),
+          "b1": jnp.asarray(rng.randn(e, o), jnp.float32)}
+    (got1,) = op1.lower(OpContext(), [x], p1)
+    assert float(jnp.min(got1)) >= 0.0
+
+
 def test_moe_capacity_drops_overflow():
     # all tokens route to one expert with tiny capacity: output must stay
     # finite and the dropped tokens contribute zeros (combine weight 0)
