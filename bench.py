@@ -1,14 +1,25 @@
 """Benchmark entry point: prints ONE JSON line for the driver.
 
-Headline metric (north-star #2 currency): steady-state incremental-decoding
-throughput through the serve stack — full batch of decode tokens per jitted
-step (Pallas flash-decode kernel on TPU), in tokens/sec.  ``vs_baseline``
-compares against the same step with the kernel disabled (the gather-based
-pure-JAX attention path, our stand-in for the reference's unfused execution
-until reference hardware numbers exist).
+Headline (north-star #2 currency): steady-state incremental-decoding TPOT /
+throughput through the serve stack at a **Llama-2-7B-shaped layer config**
+(h=4096, 32 heads, 11008 MLP, bf16, 2k context) — an 8-layer slice of the
+32-layer model, since full 7B weights + an 8-request 2k KV cache exceed one
+chip's HBM (the full model is the TP-sharded case; per-layer numbers are
+layer-count-invariant).  The decode loop runs as an ON-DEVICE ``lax.scan``
+(`InferenceManager.decode_scan`), and timing uses the slope between two scan
+lengths so the tunnel's per-dispatch latency cancels — the reported TPOT is
+device time, not host round-trip time.
 
-Also measures MNIST-MLP train throughput (BASELINE config #1) — kept as a
-secondary field inside the same JSON line.
+``vs_baseline`` compares the Pallas flash-decode kernel path against the same
+scan with the kernel disabled (the cache-row-gather pure-JAX attention — the
+stand-in for the reference's unfused execution until reference hardware
+numbers exist).  ``hbm_frac`` grounds the number against hardware: the
+fraction of peak HBM bandwidth the step sustains, counting bytes that MUST
+move (weights once per step + the causally-live KV prefix) — decode is
+bandwidth-bound, so 1.0 is the physical ceiling.
+
+Also measures MNIST-MLP train throughput (BASELINE config #1) as a secondary
+field in the same JSON line.
 """
 
 import json
@@ -16,9 +27,15 @@ import time
 
 import numpy as np
 
+PEAK_HBM = {  # bytes/sec, per chip
+    "TPU v5 lite": 819e9,   # v5e
+    "TPU v5": 2765e9,       # v5p
+    "TPU v4": 1228e9,
+}
 
-def build_im(use_pallas, layers=4, hidden=2048, heads=16, kv=16,
-             max_requests=8, max_seq=1024, vocab=32000):
+
+def build_im(use_pallas, layers, hidden, heads, kv, inter, vocab,
+             max_requests, max_seq):
     import jax
 
     from flexflow_tpu import FFConfig, FFModel
@@ -31,9 +48,9 @@ def build_im(use_pallas, layers=4, hidden=2048, heads=16, kv=16,
 
     cfg = ServeModelConfig(
         model_type="llama", vocab_size=vocab, hidden_size=hidden,
-        intermediate_size=int(hidden * 2.6875) // 128 * 128,
-        num_hidden_layers=layers, num_attention_heads=heads,
-        num_key_value_heads=kv,
+        intermediate_size=inter, num_hidden_layers=layers,
+        num_attention_heads=heads, num_key_value_heads=kv,
+        dtype="bfloat16",
     )
     mesh = make_mesh({"tp": 1}, jax.devices()[:1])
     ff = FFModel(FFConfig(), mesh=mesh)
@@ -46,34 +63,52 @@ def build_im(use_pallas, layers=4, hidden=2048, heads=16, kv=16,
     return im
 
 
-def bench_decode(use_pallas, steps=64, ctx=512):
-    """Steady-state decode: max_requests tokens per step at depth ``ctx``."""
+def bench_decode_scan(im, ctx, n_lo=8, n_hi=40, n_outer=4):
+    """Device TPOT (seconds/step) via the slope between two scan lengths."""
     import jax
 
     from flexflow_tpu.serve.batch_config import BatchConfig
 
-    im = build_im(use_pallas)
     n = im.max_requests
     rng = np.random.RandomState(0)
+    bc0 = BatchConfig.build(
+        rng.randint(1, 31999, size=n).tolist(),
+        list(range(n)), [ctx] * n, [ctx + 1] * n,
+        max_tokens=n, max_requests=n,
+    )
 
-    def bc_at(depth):
-        return BatchConfig.build(
-            rng.randint(1, 31999, size=n).tolist(),
-            list(range(n)),
-            [depth] * n,
-            [depth + 1] * n,
-            max_tokens=n,
-            max_requests=n,
-        )
+    def best_of(steps):
+        # np.asarray (not block_until_ready): a host read is the only sync
+        # that reliably waits for device completion on tunneled runtimes
+        tokens, _ = im.decode_scan(bc0, steps)  # compile + warm
+        np.asarray(tokens)
+        best = float("inf")
+        for _ in range(n_outer):
+            t0 = time.perf_counter()
+            tokens, _ = im.decode_scan(bc0, steps)
+            np.asarray(tokens)
+            best = min(best, time.perf_counter() - t0)
+        return best
 
-    result = im.step(bc_at(ctx))  # warmup / compile
-    jax.block_until_ready(result.token_ids)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        result = im.step(bc_at(ctx + 1 + i))
-    jax.block_until_ready(result.token_ids)
-    dt = time.perf_counter() - t0
-    return steps * n / dt, dt / steps * 1e3  # tokens/sec, ms/step (TPOT)
+    return (best_of(n_hi) - best_of(n_lo)) / (n_hi - n_lo)
+
+
+def step_bytes(im, ctx):
+    """Bytes that must cross HBM per decode step: all weights once + the
+    causally-live KV prefix (read) + the new KV entries (write)."""
+    import jax
+
+    p_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(im.params)
+    )
+    kv_bytes = 0
+    for bufs in im.state.values():
+        k = bufs["k"]  # [R+1, KV, S, D]
+        _, num_kv, _, d = k.shape
+        t = im.max_requests
+        kv_bytes += 2 * t * (ctx + 1) * num_kv * d * k.dtype.itemsize  # read
+        kv_bytes += 2 * t * num_kv * d * k.dtype.itemsize             # write
+    return p_bytes + kv_bytes
 
 
 def bench_mlp_train(steps: int = 50, batch: int = 64):
@@ -98,28 +133,49 @@ def bench_mlp_train(steps: int = 50, batch: int = 64):
 
     p, s = model.params, model.opt_state
     p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
-    jax.block_until_ready(loss)
+    np.asarray(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
-    jax.block_until_ready(loss)
+    np.asarray(loss)  # the last loss depends on every queued step
     dt = time.perf_counter() - t0
     return steps * batch / dt
 
 
 def main():
-    pallas_tps, pallas_tpot = bench_decode(use_pallas=True)
-    gather_tps, _ = bench_decode(use_pallas=False)
+    import jax
+
+    shape = dict(layers=8, hidden=4096, heads=32, kv=32, inter=11008,
+                 vocab=32000, max_requests=8, max_seq=2048)
+    ctx = 1800
+
+    im = build_im(use_pallas=True, **shape)
+    pallas_tpot = bench_decode_scan(im, ctx)
+    bytes_per_step = step_bytes(im, ctx)
+    del im
+
+    im = build_im(use_pallas=False, **shape)
+    gather_tpot = bench_decode_scan(im, ctx)
+    del im
+
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_HBM.get(kind)  # None on unknown hardware -> hbm_frac null
+    n = shape["max_requests"]
     mlp = bench_mlp_train()
     print(
         json.dumps(
             {
                 "metric": "serve_decode_throughput",
-                "value": round(pallas_tps, 1),
+                "value": round(n / pallas_tpot, 1),
                 "unit": "tokens/sec",
-                "vs_baseline": round(pallas_tps / gather_tps, 3),
-                "tpot_ms": round(pallas_tpot, 3),
+                "vs_baseline": round(gather_tpot / pallas_tpot, 3),
+                "tpot_ms": round(pallas_tpot * 1e3, 3),
+                "gather_tpot_ms": round(gather_tpot * 1e3, 3),
+                "hbm_frac": round(bytes_per_step / (pallas_tpot * peak), 3)
+                if peak else None,
+                "config": "llama2-7b-shape 8-layer slice, bf16, bs=8, ctx=1800",
+                "device": kind,
                 "mnist_mlp_train_samples_per_sec": round(mlp, 1),
             }
         )

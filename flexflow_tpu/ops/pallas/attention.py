@@ -4,19 +4,25 @@ TPU-native replacement for the reference's fused decode-attention CUDA kernel
 (reference: ``src/ops/inc_multihead_self_attention.cu`` — the per-token
 "attend over my request's KV cache" hot loop).  The pure-JAX fallback in
 :mod:`flexflow_tpu.serve.ops` gathers each token's full cache row
-(``[T, S, KV, D]`` materialized in HBM); this kernel streams cache blocks
+(``[T, KV, S, D]`` materialized in HBM); this kernel streams cache blocks
 HBM→VMEM instead, with the per-token cache-row index scalar-prefetched so the
 DMA pipeline knows where to fetch before the body runs.
 
-Design:
+Design (v2 — measured on a real v5e chip):
+* cache layout is **kv-head-major**: ``[rows, KV, S, D]``.  A block is then
+  ``[KV, Bs, D]`` with contiguous ``(sublane, lane)`` tiles per head, so the
+  score/value contractions are single ``dot_general``s batched over the KV
+  dim — no per-head slicing (which on the old ``[rows, S, KV, D]`` layout
+  forced a strided relayout per head and cost ~2x).
 * grid = (tokens, seq_blocks); seq is the minor (fastest) axis so the online
   softmax state (m/l/acc scratch) carries across a token's blocks.
-* K/V cache blocks are indexed ``(rows[t], s)`` via PrefetchScalarGridSpec —
-  the Pallas analogue of the CUDA kernel's pointer chase through the cache.
-* online softmax in f32; GQA handled by a static loop over kv heads, each a
-  ``[gq, D] x [D, Bs]`` MXU contraction.
-* causal masking against the token's absolute position; optional ALiBi bias
-  (slopes passed in) so MPT-style models ride the same kernel.
+* **causal DMA clamp**: the K/V index map clamps the block index to the
+  token's causal frontier (``min(j, pos // block_s)``).  Pallas skips the
+  copy when consecutive grid steps map to the same block, so blocks entirely
+  in the future cost no HBM bandwidth — decode attention is bandwidth-bound,
+  and this alone is worth ~2x at half-full caches.
+* online softmax in f32; optional ALiBi bias (slopes passed in) so MPT-style
+  models ride the same kernel.
 
 Single-device only for now: under a >1 mesh the serve step runs in GSPMD
 global-array mode where a pallas_call would need a shard_map wrapper; the
@@ -35,18 +41,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# VMEM budget for the K+V double-buffered block pipeline (bytes); the actual
+# scoped limit is ~16MB but scratch + q/o blocks need room too.
+_VMEM_BUDGET = 8 * 2**20
+
 
 def _decode_kernel(
     rows_ref,       # scalar prefetch: i32[T] cache row per token
     pos_ref,        # scalar prefetch: i32[T] absolute position per token
-    q_ref,          # [1, QH, D] this token's queries
-    k_ref,          # [1, Bs, KV, D] cache K block (row rows[t], block s)
-    v_ref,          # [1, Bs, KV, D]
-    slopes_ref,     # [1, QH] alibi slopes (zeros when unused)
-    o_ref,          # [1, QH, D] output
-    m_ref,          # VMEM scratch [QH, 128] running max (lane-replicated)
-    l_ref,          # VMEM scratch [QH, 128] running denom
-    acc_ref,        # VMEM scratch [QH, D] running numerator
+    q_ref,          # [1, KV, gq, D] this token's queries (kv-major)
+    k_ref,          # [1, KV, Bs, D] cache K block (row rows[t], block s)
+    v_ref,          # [1, KV, Bs, D]
+    slopes_ref,     # [KV, gq] alibi slopes (zeros when unused)
+    o_ref,          # [1, KV, gq, D] output
+    m_ref,          # VMEM scratch [KV, gq, 128] running max (lane-replicated)
+    l_ref,          # VMEM scratch [KV, gq, 128] running denom
+    acc_ref,        # VMEM scratch [KV, gq, D] running numerator
     *,
     block_s: int,
     num_kv: int,
@@ -57,69 +67,56 @@ def _decode_kernel(
     t = pl.program_id(0)
     s = pl.program_id(1)
     last_s = pl.num_programs(1) - 1
-    qh = num_kv * gq
-    d = q_ref.shape[-1]
 
     @pl.when(s == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
     pos = pos_ref[t]
     base = s * block_s
 
-    @pl.when(base <= pos)  # skip blocks entirely in the future
+    @pl.when(base <= pos)  # blocks past the frontier: DMA already clamped
     def _compute():
-        # scores for every q head: static loop over kv groups
-        q = q_ref[0].astype(jnp.float32)              # [QH, D]
-        scores = []
-        for kv in range(num_kv):
-            k_blk = k_ref[0, :, kv, :].astype(jnp.float32)   # [Bs, D]
-            q_kv = q[kv * gq:(kv + 1) * gq, :]               # [gq, D]
-            scores.append(
-                jax.lax.dot_general(
-                    q_kv, k_blk,
-                    dimension_numbers=(((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )  # [gq, Bs]
-        sc = jnp.concatenate(scores, axis=0) * scale          # [QH, Bs]
+        q = q_ref[0].astype(jnp.float32)               # [KV, gq, D]
+        k = k_ref[0].astype(jnp.float32)               # [KV, Bs, D]
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [KV, gq, Bs]
 
         key_pos = base + jax.lax.broadcasted_iota(
-            jnp.int32, (qh, block_s), 1
+            jnp.int32, (num_kv, gq, block_s), 2
         )
         if use_alibi:
-            slopes = slopes_ref[0][:, None].astype(jnp.float32)
+            slopes = slopes_ref[...][:, :, None].astype(jnp.float32)
             sc = sc + slopes * (key_pos - pos).astype(jnp.float32)
         sc = jnp.where(key_pos <= pos, sc, NEG_INF)
 
-        m_prev = m_ref[:, 0:1]                                # [QH, 1]
-        m_cur = jnp.max(sc, axis=-1, keepdims=True)           # [QH, 1]
+        m_prev = m_ref[:, :, 0:1]                       # [KV, gq, 1]
+        m_cur = jnp.max(sc, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)                       # [QH, 1]
-        p = jnp.exp(sc - m_new)                               # [QH, Bs]
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new)                         # [KV, gq, Bs]
         # mask again post-exp: exp(NEG_INF - m) may not be exactly 0 when a
         # block is fully masked and m_new is NEG_INF (NEG_INF-NEG_INF = 0)
         p = jnp.where(key_pos <= pos, p, 0.0)
 
-        l_new = alpha * l_ref[:, 0:1] + jnp.sum(p, -1, keepdims=True)
-        pv = []
-        for kv in range(num_kv):
-            v_blk = v_ref[0, :, kv, :].astype(jnp.float32)    # [Bs, D]
-            p_kv = p[kv * gq:(kv + 1) * gq, :]                # [gq, Bs]
-            pv.append(
-                jnp.dot(p_kv, v_blk, preferred_element_type=jnp.float32)
-            )
-        pv = jnp.concatenate(pv, axis=0)                      # [QH, D]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)                # [KV, Bs, D]
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                               # [KV, gq, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(s == last_s)
     def _finalize():
-        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
-        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        denom = jnp.maximum(l_ref[:, :, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -128,58 +125,65 @@ def _decode_kernel(
 )
 def decode_attention(
     q: jax.Array,        # [T, QH, D] (RoPE already applied)
-    k_cache: jax.Array,  # [R+1, S, KV, D] (current step's KV already written)
-    v_cache: jax.Array,  # [R+1, S, KV, D]
+    k_cache: jax.Array,  # [R+1, KV, S, D] (current step's KV already written)
+    v_cache: jax.Array,  # [R+1, KV, S, D]
     rows: jax.Array,     # i32[T] cache row per token
     positions: jax.Array,  # i32[T]
     scale: float,
     slopes: Optional[jax.Array] = None,  # [QH] alibi slopes
-    block_s: int = 128,
+    block_s: int = 512,
     use_alibi: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
     t, qh, d = q.shape
-    _, s_len, num_kv, _ = k_cache.shape
+    _, num_kv, s_len, _ = k_cache.shape
     gq = qh // num_kv
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    # cap the block so K+V double-buffered blocks fit the VMEM budget
+    while (block_s > 128
+           and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET):
+        block_s //= 2
     block_s = min(block_s, s_len)
     # non-dividing tails are fine: the grid rounds up and the causal mask
     # (key_pos <= pos, with pos < s_len) discards the padded region
     n_blocks = pl.cdiv(s_len, block_s)
+    qr = q.reshape(t, num_kv, gq, d)
     if slopes is None:
         slopes = jnp.zeros((qh,), jnp.float32)
-    slopes = jnp.broadcast_to(slopes.astype(jnp.float32)[None, :], (1, qh))
+    slopes = slopes.astype(jnp.float32).reshape(num_kv, gq)
+
+    def kv_map(i, j, rows, pos):
+        # clamp to the causal frontier: future blocks re-map to the frontier
+        # block, whose copy Pallas then skips (same index as previous step)
+        return (rows[i], 0, jnp.minimum(j, pos[i] // block_s), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(t, n_blocks),
         in_specs=[
             pl.BlockSpec(
-                (1, qh, d), lambda i, j, rows, pos: (i, 0, 0),
+                (1, num_kv, gq, d), lambda i, j, rows, pos: (i, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_s, num_kv, d),
-                lambda i, j, rows, pos: (rows[i], j, 0, 0),
-                memory_space=pltpu.VMEM,
+                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, block_s, num_kv, d),
-                lambda i, j, rows, pos: (rows[i], j, 0, 0),
-                memory_space=pltpu.VMEM,
+                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, qh), lambda i, j, rows, pos: (0, 0),
+                (num_kv, gq), lambda i, j, rows, pos: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, qh, d), lambda i, j, rows, pos: (i, 0, 0),
+            (1, num_kv, gq, d), lambda i, j, rows, pos: (i, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((qh, 128), jnp.float32),
-            pltpu.VMEM((qh, 128), jnp.float32),
-            pltpu.VMEM((qh, d), jnp.float32),
+            pltpu.VMEM((num_kv, gq, 128), jnp.float32),
+            pltpu.VMEM((num_kv, gq, 128), jnp.float32),
+            pltpu.VMEM((num_kv, gq, d), jnp.float32),
         ],
     )
     kernel = functools.partial(
@@ -187,10 +191,11 @@ def decode_attention(
         block_s=block_s, num_kv=num_kv, gq=gq,
         scale=float(scale), use_alibi=use_alibi,
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((t, qh, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((t, num_kv, gq, d), q.dtype),
         interpret=interpret,
     )(rows.astype(jnp.int32), positions.astype(jnp.int32),
-      q, k_cache, v_cache, slopes)
+      qr, k_cache, v_cache, slopes)
+    return out.reshape(t, qh, d)

@@ -59,6 +59,30 @@ class BatchConfig:
     def max_requests(self) -> int:
         return self.seq_lens.shape[0]
 
+    def advance(self, token_ids: jax.Array) -> "BatchConfig":
+        """Next pure-decode step's config, computed ON DEVICE.
+
+        For a batch where every valid slot is a decode token (one token per
+        active request), the next step feeds each slot the token just
+        produced for it, one position further.  This is what lets the decode
+        loop run as a ``lax.scan`` entirely on device — the TPU-native
+        answer to the reference's per-step host round trip through
+        ``RequestManager::prepare_next_batch`` (the host only syncs every
+        N steps).  Prefill/mixed batches must go through ``build``.
+        """
+        active = self.request_index >= 0
+        req = jnp.clip(self.request_index, 0, self.max_requests - 1)
+        seq_lens = self.seq_lens + jnp.zeros_like(self.seq_lens).at[req].add(
+            active.astype(self.seq_lens.dtype)
+        )
+        return BatchConfig(
+            tokens=jnp.where(active, token_ids, self.tokens),
+            request_index=self.request_index,
+            token_position=self.token_position + active.astype(jnp.int32),
+            num_tokens=self.num_tokens,
+            seq_lens=seq_lens,
+        )
+
     @staticmethod
     def build(
         token_ids,

@@ -128,6 +128,11 @@ class InferenceManager:
             self.use_pallas = bool(use_pallas)
         self.pallas_interpret = backend != "tpu"
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._scan = jax.jit(
+            self._decode_scan_impl,
+            donate_argnums=(1,),
+            static_argnames=("n_steps",),
+        )
 
     # ------------------------------------------------------------------
     def init_operators_inference(self, params=None, rng=None, dtype=None):
@@ -201,6 +206,46 @@ class InferenceManager:
         assert self.params is not None, "call init_operators_inference() first"
         result, self.state = self._step(self.params, self.state, bc)
         return result
+
+    # ------------------------------------------------------------------
+    def _decode_scan_impl(self, params, state, bc, n_steps: int):
+        """n_steps pure-decode steps as ONE on-device ``lax.scan``.
+
+        TPU-first redesign of the reference's serving loop (§3.3): instead of
+        a host round trip per token (``prepare_next_batch`` → dispatch →
+        sync), the next step's BatchConfig is derived on device from the
+        step's argmax (``BatchConfig.advance``) and the host only syncs once
+        per scan.  With dispatch latency L and device step time t, TPOT drops
+        from ``max(L, t)`` to ``t + L/n_steps``.
+        """
+        def body(carry, _):
+            state, bc = carry
+            result, state = self._step_impl(params, state, bc)
+            return (state, bc.advance(result.token_ids)), result.token_ids
+
+        (state, bc), tokens = jax.lax.scan(
+            body, (state, bc), None, length=n_steps
+        )
+        return tokens, state, bc
+
+    def decode_scan(self, bc, n_steps: int):
+        """Run ``n_steps`` decode steps on device; returns i32[n_steps, T]
+        token ids (position p's output for each flat slot) and the advanced
+        BatchConfig for the host to resume from."""
+        assert self.params is not None, "call init_operators_inference() first"
+        import numpy as np
+
+        last = int(np.max(np.asarray(bc.token_position))) + n_steps
+        if last > self.max_seq_len:
+            raise ValueError(
+                f"decode_scan would reach position {last} > max_seq_len "
+                f"{self.max_seq_len}; cache writes past the end clamp to the "
+                "last slot and silently corrupt it"
+            )
+        tokens, self.state, bc = self._scan(
+            self.params, self.state, bc, n_steps=n_steps
+        )
+        return tokens, bc
 
     def reset(self):
         """Clear all cache contents (new serving session)."""

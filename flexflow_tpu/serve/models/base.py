@@ -48,6 +48,11 @@ class ServeModelConfig:
     bias: bool = False                # falcon-rw: linear biases
     use_alibi: bool = False           # mpt
     new_decoder_architecture: bool = False  # falcon >= 40b
+    # compute/cache dtype for the whole graph: the token embedding is built
+    # in this dtype and every downstream op inherits it (x.dtype plumbing),
+    # including the attention ops' KV caches.  "bfloat16" is the TPU-native
+    # serving dtype (HF config.json's torch_dtype maps here).
+    dtype: str = "float32"
 
     @property
     def kv_heads(self) -> int:
@@ -110,6 +115,11 @@ class ServeModelConfig:
                 kw["use_alibi"] = aget("alibi")
         if get("model_type") == "gpt_bigcode" and "intermediate_size" not in kw:
             kw["intermediate_size"] = 4 * kw["hidden_size"]
+        td = get("torch_dtype", None)
+        if td is not None:
+            td = str(td).replace("torch.", "")
+            # fp16 has no TPU hardware path; bf16 is the TPU half-precision
+            kw["dtype"] = "bfloat16" if td in ("float16", "bfloat16") else td
         return ServeModelConfig(**kw)
 
 

@@ -29,8 +29,7 @@ def build_falcon(ff, cfg: ServeModelConfig, max_tokens: int):
     tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
     x = ff.embedding(
         tokens, cfg.vocab_size, cfg.hidden_size,
-        name="transformer.word_embeddings",
-    )
+        name="transformer.word_embeddings", dtype=jnp.dtype(cfg.dtype))
     for i in range(cfg.num_hidden_layers):
         p = f"transformer.h.{i}"
         h = ff.layer_norm(x, eps=cfg.layer_norm_eps,

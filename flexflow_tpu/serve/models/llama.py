@@ -18,8 +18,7 @@ from .base import ServeModelConfig, register_model
 def build_llama(ff, cfg: ServeModelConfig, max_tokens: int):
     tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
     x = ff.embedding(
-        tokens, cfg.vocab_size, cfg.hidden_size, name="model.embed_tokens"
-    )
+        tokens, cfg.vocab_size, cfg.hidden_size, name="model.embed_tokens", dtype=jnp.dtype(cfg.dtype))
     residual, mlp_out = x, None
     for i in range(cfg.num_hidden_layers):
         if i == 0:

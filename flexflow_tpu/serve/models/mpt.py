@@ -16,8 +16,7 @@ from .base import ServeModelConfig, register_model
 def build_mpt(ff, cfg: ServeModelConfig, max_tokens: int):
     tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
     x = ff.embedding(
-        tokens, cfg.vocab_size, cfg.hidden_size, name="transformer.wte"
-    )
+        tokens, cfg.vocab_size, cfg.hidden_size, name="transformer.wte", dtype=jnp.dtype(cfg.dtype))
     for i in range(cfg.num_hidden_layers):
         p = f"transformer.blocks.{i}"
         h = ff.layer_norm(x, eps=cfg.layer_norm_eps, use_bias=False,

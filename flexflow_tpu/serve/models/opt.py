@@ -21,8 +21,7 @@ def build_opt(ff, cfg: ServeModelConfig, max_tokens: int):
     embed_dim = cfg.word_embed_proj_dim or cfg.hidden_size
     tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
     x = ff.embedding(
-        tokens, cfg.vocab_size, embed_dim, name="model.decoder.embed_tokens"
-    )
+        tokens, cfg.vocab_size, embed_dim, name="model.decoder.embed_tokens", dtype=jnp.dtype(cfg.dtype))
     if embed_dim != cfg.hidden_size:
         x = ff.dense(x, cfg.hidden_size, use_bias=False,
                      name="model.decoder.project_in")

@@ -16,8 +16,7 @@ from .base import ServeModelConfig, register_model
 def build_starcoder(ff, cfg: ServeModelConfig, max_tokens: int):
     tokens = ff.create_tensor((max_tokens,), dtype=jnp.int32)
     x = ff.embedding(
-        tokens, cfg.vocab_size, cfg.hidden_size, name="transformer.wte"
-    )
+        tokens, cfg.vocab_size, cfg.hidden_size, name="transformer.wte", dtype=jnp.dtype(cfg.dtype))
     x = ff.position_embedding(
         x, cfg.max_position_embeddings, offset=0, name="transformer.wpe"
     )

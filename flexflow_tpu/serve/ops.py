@@ -172,9 +172,14 @@ class IncMultiHeadSelfAttention(Op):
         max_spec_tokens: int = 0,
         head_axes: Tuple[str, ...] = (),
     ) -> Dict[str, Tuple[Tuple[int, ...], str, TensorSharding]]:
-        """{name: (shape, dtype, sharding)} for this op's cache buffers."""
-        kv_shape = (max_requests + 1, max_seq_len, self.num_kv_heads, self.head_dim)
-        sh = TensorSharding.from_axes(4, {2: head_axes} if head_axes else {})
+        """{name: (shape, dtype, sharding)} for this op's cache buffers.
+
+        Caches are **kv-head-major** ``[rows, KV, S, D]`` so the Pallas
+        decode kernel streams contiguous per-head blocks (see
+        ``ops/pallas/attention.py``); the head shard axis is dim 1.
+        """
+        kv_shape = (max_requests + 1, self.num_kv_heads, max_seq_len, self.head_dim)
+        sh = TensorSharding.from_axes(4, {1: head_axes} if head_axes else {})
         out = {
             "k": (kv_shape, self.dtype, sh),
             "v": (kv_shape, self.dtype, sh),
@@ -182,17 +187,19 @@ class IncMultiHeadSelfAttention(Op):
         if max_spec_tokens:
             sp_shape = (
                 max_requests + 1,
-                max_spec_tokens,
                 self.num_kv_heads,
+                max_spec_tokens,
                 self.head_dim,
             )
             out["sk"] = (sp_shape, self.dtype, sh)
             out["sv"] = (sp_shape, self.dtype, sh)
             if self.use_alibi:
                 # absolute position of each spec-buffer slot (ALiBi needs key
-                # positions; rope bakes them into sk at write time instead)
+                # positions; rope bakes them into sk at write time instead);
+                # [rows, max_spec_tokens] — no head dim
                 out["spec_pos"] = (
-                    sp_shape[:2], "int32", TensorSharding.replicated(2)
+                    (sp_shape[0], sp_shape[2]), "int32",
+                    TensorSharding.replicated(2),
                 )
         return out
 
@@ -253,13 +260,71 @@ class IncMultiHeadSelfAttention(Op):
         r = bc_base.request_index
         return jnp.where(r >= 0, r, max_requests)
 
+    @staticmethod
+    def _scatter_rows_pos(cache, rows, pos, updates):
+        """``cache[rows[t], :, pos[t]] = updates[t]`` without transposes.
+
+        ``cache.at[rows, :, pos].set(...)`` is advanced indices split by a
+        slice — NumPy semantics force jnp to transpose the whole cache to
+        put the indexed dims together, which inside the decode scan copied
+        the multi-GB cache every step.  A per-token ``dynamic_update_slice``
+        chain updates in place AND is layout-agnostic: an XLA ``scatter``
+        here makes layout assignment pick a non-default cache layout for
+        the decode-scan carry, forcing a full-cache relayout copy per step
+        to feed the Pallas kernel's default-layout operand.
+        For large token counts (prefill chunks) the unrolled DUS chain would
+        bloat compile time and serialize, so fall back to one XLA scatter —
+        the layout concern only bites inside the decode scan, whose batches
+        are at most ``max_requests`` tokens.
+        cache: [R, H, S, D], updates: [T, H, D].
+        """
+        t, h, d = updates.shape
+        upd = updates.astype(cache.dtype)
+        rows = rows.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        if t > 32:
+            idx = jnp.stack([rows, pos], axis=-1)
+            dnums = jax.lax.ScatterDimensionNumbers(
+                update_window_dims=(1, 2),
+                inserted_window_dims=(0, 2),
+                scatter_dims_to_operand_dims=(0, 2),
+            )
+            return jax.lax.scatter(
+                cache, idx, upd, dnums,
+                mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+            )
+        for i in range(t):
+            cache = jax.lax.dynamic_update_slice(
+                cache, upd[i].reshape(1, h, 1, d),
+                (rows[i], jnp.int32(0), pos[i], jnp.int32(0)),
+            )
+        return cache
+
+    @staticmethod
+    def _gather_rows_pos(cache, rows, pos):
+        """``[T, H, D] = cache[rows[t], :, pos[t]]`` (same no-transpose
+        reasoning as :meth:`_scatter_rows_pos`)."""
+        idx = jnp.stack(
+            [rows.astype(jnp.int32), pos.astype(jnp.int32)], axis=-1
+        )
+        dnums = jax.lax.GatherDimensionNumbers(
+            offset_dims=(1, 2),
+            collapsed_slice_dims=(0, 2),
+            start_index_map=(0, 2),
+        )
+        return jax.lax.gather(
+            cache, idx, dnums,
+            slice_sizes=(1, cache.shape[1], 1, cache.shape[3]),
+            mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+        )
+
     def _inc_attend(self, q, k, v, state, bc: BatchConfig, ctx=None):
-        kc, vc = state["k"], state["v"]
+        kc, vc = state["k"], state["v"]  # [R+1, KV, S, D]
         nreq = kc.shape[0] - 1
         rows = self._rows(bc, nreq)
         pos = bc.token_position
-        kc = kc.at[rows, pos].set(k.astype(kc.dtype))
-        vc = vc.at[rows, pos].set(v.astype(vc.dtype))
+        kc = self._scatter_rows_pos(kc, rows, pos, k)
+        vc = self._scatter_rows_pos(vc, rows, pos, v)
         if ctx is not None and ctx.extras.get("pallas_decode"):
             from ..ops.pallas.attention import decode_attention
 
@@ -276,14 +341,14 @@ class IncMultiHeadSelfAttention(Op):
             new_state = dict(state)
             new_state["k"], new_state["v"] = kc, vc
             return out, new_state
-        # fallback: gather each token's cache row: [T, S, KV, D]
+        # fallback: gather each token's cache row: [T, KV, S, D]
         k_tok = kc[rows]
         v_tok = vc[rows]
-        s = k_tok.shape[1]
+        s = k_tok.shape[2]
         # causal over absolute positions (covers prefill + decode uniformly)
         mask = jnp.arange(s)[None, :] <= pos[:, None]  # [T, S]
         scores = jnp.einsum(
-            "tkgd,tskd->tkgs", q, k_tok, preferred_element_type=jnp.float32
+            "tkgd,tksd->tkgs", q, k_tok, preferred_element_type=jnp.float32
         )
         scores = scores * self.scaling_factor
         if self.use_alibi:
@@ -295,7 +360,7 @@ class IncMultiHeadSelfAttention(Op):
         scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
         w = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum(
-            "tkgs,tskd->tkgd", w, v_tok.astype(w.dtype),
+            "tkgs,tksd->tkgd", w, v_tok.astype(w.dtype),
             preferred_element_type=jnp.float32,
         )
         t = q.shape[0]
@@ -315,10 +380,14 @@ class IncMultiHeadSelfAttention(Op):
         kc, vc, sk, sv = state["k"], state["v"], state["sk"], state["sv"]
         nreq = kc.shape[0] - 1
         rows = jnp.where(bc.commit_request_index >= 0, bc.commit_request_index, nreq)
-        src = jnp.clip(bc.commit_src_spec_index, 0, sk.shape[1] - 1)
-        dst = jnp.clip(bc.commit_dst_position, 0, kc.shape[1] - 1)
-        kc = kc.at[rows, dst].set(sk[rows, src])
-        vc = vc.at[rows, dst].set(sv[rows, src])
+        src = jnp.clip(bc.commit_src_spec_index, 0, sk.shape[2] - 1)
+        dst = jnp.clip(bc.commit_dst_position, 0, kc.shape[2] - 1)
+        kc = self._scatter_rows_pos(
+            kc, rows, dst, self._gather_rows_pos(sk, rows, src)
+        )
+        vc = self._scatter_rows_pos(
+            vc, rows, dst, self._gather_rows_pos(sv, rows, src)
+        )
         new_state = dict(state)
         new_state["k"], new_state["v"] = kc, vc
         return new_state
@@ -334,20 +403,20 @@ class IncMultiHeadSelfAttention(Op):
         kc, vc, sk, sv = state["k"], state["v"], state["sk"], state["sv"]
         nreq = kc.shape[0] - 1
         rows = self._rows(base, nreq)
-        spec_idx = jnp.clip(bc.spec_index, 0, sk.shape[1] - 1)
-        sk = sk.at[rows, spec_idx].set(k.astype(sk.dtype))
-        sv = sv.at[rows, spec_idx].set(v.astype(sv.dtype))
+        spec_idx = jnp.clip(bc.spec_index, 0, sk.shape[2] - 1)
+        sk = self._scatter_rows_pos(sk, rows, spec_idx, k)
+        sv = self._scatter_rows_pos(sv, rows, spec_idx, v)
         spec_pos = None
         if self.use_alibi:
             spec_pos = state["spec_pos"].at[rows, spec_idx].set(
                 base.token_position
             )
 
-        k_cache_tok = kc[rows]   # [T, S, KV, D]
+        k_cache_tok = kc[rows]   # [T, KV, S, D]
         v_cache_tok = vc[rows]
-        k_spec_tok = sk[rows]    # [T, P, KV, D]
+        k_spec_tok = sk[rows]    # [T, KV, P, D]
         v_spec_tok = sv[rows]
-        s = k_cache_tok.shape[1]
+        s = k_cache_tok.shape[2]
 
         # committed part: strictly below the committed frontier
         cmask = jnp.arange(s)[None, :] < bc.committed_lens[rows][:, None]
@@ -355,10 +424,10 @@ class IncMultiHeadSelfAttention(Op):
         amask = bc.ancestor_mask[rows, spec_idx]  # [T, P]
 
         sc_c = jnp.einsum(
-            "tkgd,tskd->tkgs", q, k_cache_tok, preferred_element_type=jnp.float32
+            "tkgd,tksd->tkgs", q, k_cache_tok, preferred_element_type=jnp.float32
         ) * self.scaling_factor
         sc_p = jnp.einsum(
-            "tkgd,tpkd->tkgp", q, k_spec_tok, preferred_element_type=jnp.float32
+            "tkgd,tkpd->tkgp", q, k_spec_tok, preferred_element_type=jnp.float32
         ) * self.scaling_factor
         if self.use_alibi:
             slopes = alibi_slopes(self.num_q_heads).reshape(
@@ -373,9 +442,9 @@ class IncMultiHeadSelfAttention(Op):
         sc_p = jnp.where(amask[:, None, None, :], sc_p, NEG_INF)
         scores = jnp.concatenate([sc_c, sc_p], axis=-1)
         w = jax.nn.softmax(scores, axis=-1)
-        v_all = jnp.concatenate([v_cache_tok, v_spec_tok], axis=1).astype(w.dtype)
+        v_all = jnp.concatenate([v_cache_tok, v_spec_tok], axis=2).astype(w.dtype)
         out = jnp.einsum(
-            "tkgs,tskd->tkgd", w, v_all, preferred_element_type=jnp.float32
+            "tkgs,tksd->tkgd", w, v_all, preferred_element_type=jnp.float32
         )
         t = q.shape[0]
         out = out.reshape(t, self.num_q_heads, self.head_dim).astype(q.dtype)

@@ -18,20 +18,20 @@ from test_serve import TINY, make_im, ref_greedy_decode
 
 def ref_attention(q, kc, vc, rows, pos, scale, slopes=None):
     """The gather-based formulation (what serve/ops.py falls back to)."""
-    k_tok = kc[rows]  # [T, S, KV, D]
+    k_tok = kc[rows]  # [T, KV, S, D] (kv-head-major cache)
     v_tok = vc[rows]
-    t, s, kv, d = k_tok.shape
+    t, kv, s, d = k_tok.shape
     qh = q.shape[1]
     gq = qh // kv
     qr = q.reshape(t, kv, gq, d)
-    sc = jnp.einsum("tkgd,tskd->tkgs", qr, k_tok).astype(jnp.float32) * scale
+    sc = jnp.einsum("tkgd,tksd->tkgs", qr, k_tok).astype(jnp.float32) * scale
     if slopes is not None:
         rel = (jnp.arange(s)[None, :] - pos[:, None]).astype(jnp.float32)
         sc = sc + slopes.reshape(kv, gq)[None, :, :, None] * rel[:, None, None, :]
     mask = jnp.arange(s)[None, :] <= pos[:, None]
     sc = jnp.where(mask[:, None, None, :], sc, -1e30)
     w = jax.nn.softmax(sc, axis=-1)
-    out = jnp.einsum("tkgs,tskd->tkgd", w, v_tok.astype(w.dtype))
+    out = jnp.einsum("tkgs,tksd->tkgd", w, v_tok.astype(w.dtype))
     return out.reshape(t, qh, d)
 
 
@@ -45,8 +45,8 @@ def test_kernel_matches_reference(qh, kv, d, s, block):
     rng = np.random.default_rng(0)
     t, r = 6, 3
     q = jnp.asarray(rng.normal(size=(t, qh, d)), jnp.float32)
-    kc = jnp.asarray(rng.normal(size=(r + 1, s, kv, d)), jnp.float32)
-    vc = jnp.asarray(rng.normal(size=(r + 1, s, kv, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
     rows = jnp.asarray([0, 1, 2, 1, 0, 3], jnp.int32)  # 3 = pad scratch row
     pos = jnp.asarray([5, 17, 0, 18, 6, 0], jnp.int32)
     scale = 1.0 / np.sqrt(d)
@@ -61,8 +61,8 @@ def test_kernel_alibi_matches_reference():
     rng = np.random.default_rng(1)
     t, r, qh, kv, d, s = 5, 2, 4, 2, 8, 32
     q = jnp.asarray(rng.normal(size=(t, qh, d)), jnp.float32)
-    kc = jnp.asarray(rng.normal(size=(r + 1, s, kv, d)), jnp.float32)
-    vc = jnp.asarray(rng.normal(size=(r + 1, s, kv, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
     rows = jnp.asarray([0, 1, 0, 1, 2], jnp.int32)
     pos = jnp.asarray([3, 9, 4, 10, 0], jnp.int32)
     slopes = alibi_slopes(qh)

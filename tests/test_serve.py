@@ -159,3 +159,43 @@ def test_eos_stops_generation():
     )
     out = rm2.generate([[3, 5]], max_new_tokens=4)[0]
     assert out == [first]
+
+
+def test_decode_scan_matches_stepwise():
+    # the on-device multi-step decode loop must produce exactly the tokens
+    # the host-driven per-step loop produces
+    from flexflow_tpu.serve.batch_config import BatchConfig
+
+    prompt = [3, 11, 25, 40, 7]
+    n_new = 6
+
+    im = make_im()
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=1))
+    first = rm.generate([prompt], max_new_tokens=1)[0][-1]
+
+    # host-driven continuation
+    def stepwise(im, first):
+        toks = [first]
+        for i in range(n_new - 1):
+            bc = BatchConfig.build(
+                [toks[-1]], [0], [len(prompt) + i], [len(prompt) + i + 1],
+                max_tokens=im.max_tokens, max_requests=im.max_requests,
+            )
+            r = im.step(bc)
+            toks.append(int(r.token_ids[0]))
+        return toks
+
+    want = stepwise(im, first)
+
+    im2 = make_im()
+    rm2 = RequestManager(im2, GenerationConfig(max_new_tokens=1))
+    first2 = rm2.generate([prompt], max_new_tokens=1)[0][-1]
+    assert first2 == first
+    bc = BatchConfig.build(
+        [first2], [0], [len(prompt)], [len(prompt) + 1],
+        max_tokens=im2.max_tokens, max_requests=im2.max_requests,
+    )
+    tokens, bc_out = im2.decode_scan(bc, n_new - 1)
+    got = [first2] + [int(t) for t in np.asarray(tokens)[:, 0]]
+    assert got == want
+    assert int(bc_out.token_position[0]) == len(prompt) + n_new - 1
