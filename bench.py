@@ -143,6 +143,29 @@ def bench_mlp_train(steps: int = 50, batch: int = 64):
     return steps * batch / dt
 
 
+def searched_vs_dp_fields():
+    """Run bench_search.py (north-star #1: Unity search vs hand-DP) in a
+    subprocess — it needs the 8-device virtual CPU mesh, and this process
+    is pinned to the TPU backend."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "bench_search.py")],
+            capture_output=True, text=True, timeout=300, cwd=here,
+        )
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        return {
+            "searched_vs_dp_sim": doc["searched_vs_dp_sim"],
+            "searched_vs_dp_wallclock": doc["searched_vs_dp_wallclock"],
+        }
+    except Exception as e:  # bench must still print its line
+        return {"searched_vs_dp_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def main():
     import jax
 
@@ -163,23 +186,21 @@ def main():
     peak = PEAK_HBM.get(kind)  # None on unknown hardware -> hbm_frac null
     n = shape["max_requests"]
     mlp = bench_mlp_train()
-    print(
-        json.dumps(
-            {
-                "metric": "serve_decode_throughput",
-                "value": round(n / pallas_tpot, 1),
-                "unit": "tokens/sec",
-                "vs_baseline": round(gather_tpot / pallas_tpot, 3),
-                "tpot_ms": round(pallas_tpot * 1e3, 3),
-                "gather_tpot_ms": round(gather_tpot * 1e3, 3),
-                "hbm_frac": round(bytes_per_step / (pallas_tpot * peak), 3)
-                if peak else None,
-                "config": "llama2-7b-shape 8-layer slice, bf16, bs=8, ctx=1800",
-                "device": kind,
-                "mnist_mlp_train_samples_per_sec": round(mlp, 1),
-            }
-        )
-    )
+    doc = {
+        "metric": "serve_decode_throughput",
+        "value": round(n / pallas_tpot, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(gather_tpot / pallas_tpot, 3),
+        "tpot_ms": round(pallas_tpot * 1e3, 3),
+        "gather_tpot_ms": round(gather_tpot * 1e3, 3),
+        "hbm_frac": round(bytes_per_step / (pallas_tpot * peak), 3)
+        if peak else None,
+        "config": "llama2-7b-shape 8-layer slice, bf16, bs=8, ctx=1800",
+        "device": kind,
+        "mnist_mlp_train_samples_per_sec": round(mlp, 1),
+    }
+    doc.update(searched_vs_dp_fields())
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

@@ -62,21 +62,69 @@ class CostCache:
         os.replace(tmp, self.path)
 
 
-def time_fn(fn, args, iters: int = 10, warmup: int = 2) -> float:
-    """Median wall time of a jitted callable (post-compile)."""
-    jitted = jax.jit(fn)
-    out = jitted(*args)
-    jax.block_until_ready(out)
-    for _ in range(warmup):
-        out = jitted(*args)
-    jax.block_until_ready(out)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = jitted(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+def time_fn(fn, args, iters: int = 6, n_lo: int = 32,
+            target_signal: float = 0.6) -> float:
+    """Per-call device time of ``fn(*args)``.
+
+    Measured as the slope between two on-device ``lax.scan`` chain lengths:
+    on tunneled/remote runtimes a single dispatch carries a large fixed
+    latency (tens of ms) that swamps microsecond kernels, and
+    ``block_until_ready`` may return before device completion — chaining n
+    calls with a negligible data dependency and host-reading a scalar probe
+    cancels both.  ``n_hi`` adapts so the slope signal is ~``target_signal``
+    seconds.
+    """
+    import functools
+
+    leaves, treedef = jax.tree.flatten(args)
+
+    # carry = (float arg leaves + a synthetic accumulator, int leaves ride
+    # along unchanged).  The dependency folded into the carry must consume
+    # EVERY output element: a single-element probe lets XLA dead-code-
+    # eliminate all kernel work not feeding that element (measured 6.5x
+    # low on a chained matmul), and an all-int carry would let it delete
+    # the op entirely.
+    def body(carry, _):
+        lvs, acc = carry
+        outs = fn(*jax.tree.unflatten(treedef, lvs))
+        f_outs = [o for o in jax.tree.leaves(outs)
+                  if hasattr(o, "dtype") and o.dtype.kind == "f"]
+        dep = sum((jnp.sum(o.astype(jnp.float32)) for o in f_outs),
+                  jnp.float32(0)) * 1e-30
+        new = [l + dep.astype(l.dtype)
+               if hasattr(l, "dtype") and l.dtype.kind == "f" else l
+               for l in lvs]
+        return (new, acc + dep), None
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def chained(lvs, n):
+        (_, acc), _ = jax.lax.scan(body, (lvs, jnp.float32(0)), None,
+                                   length=n)
+        return acc
+
+    def best_of(n):
+        np.asarray(chained(leaves, n))  # compile + warm
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(chained(leaves, n))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # phase 1: estimate with a mid-size chain.  A slow (ms-scale) op shows a
+    # clear signal here already, so a noise-negative estimate can only occur
+    # for cheap ops, where the capped 100k-call chain stays ~seconds.
+    mid = 16 * n_lo
+    t_lo = best_of(n_lo)
+    t_mid = best_of(mid)
+    est = (t_mid - t_lo) / (mid - n_lo)
+    if t_mid - t_lo >= target_signal:
+        return max(est, 1e-9)
+    # phase 2: grow the chain until the slope signal is ~target_signal
+    est = max(est, 1e-8)
+    n_hi = n_lo + min(int(target_signal / est), 100000)
+    t_hi = best_of(n_hi)
+    return max((t_hi - t_lo) / (n_hi - n_lo), 1e-9)
 
 
 def measure_operator_cost(

@@ -109,3 +109,26 @@ def test_grad_allreduce_cost_counted(tf_model):
     assert cost.grad_comm > 0  # replicated params + sharded batch => psum cost
     cost_inf = simulate(PCG(model.graph, mesh, dp).plan(), training=False)
     assert cost_inf.grad_comm == 0
+
+
+def test_search_with_measured_v5e_costs_beats_dp(tf_model):
+    """North-star #1 shape: with the committed v5e measured-cost artifact and
+    the v5e machine model, the searched strategy beats hand-DP-over-all-axes
+    in simulated step time (the bench_search.py path)."""
+    import os
+
+    from flexflow_tpu.search.measure import CostCache
+
+    model, mesh = tf_model
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    costs = CostCache(os.path.join(root, "artifacts", "tpu_costs_v5e.json"))
+    assert costs.data, "calibration artifact missing"
+    v5e = MachineModel.for_mesh(mesh, spec_name="v5e")
+    dp = data_parallel_strategy(model.graph, mesh, axes=("dp", "tp"))
+    best = graph_optimize(model.graph, mesh, budget=200, machine=v5e,
+                          measured=costs, seed=0, init=dp)
+    c_dp = simulate(PCG(model.graph, mesh, dp).plan(), v5e,
+                    measured=costs).total
+    c_best = simulate(PCG(model.graph, mesh, best).plan(), v5e,
+                      measured=costs).total
+    assert c_best < c_dp
