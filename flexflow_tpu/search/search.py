@@ -10,9 +10,10 @@ the Metropolis/MCMC walk proposes single-op config changes, and the simulator
 plans — resharding nodes inserted by the PCG normalizer are costed as the
 communication they will actually become.
 
-Algebraic substitutions (operator fusion rewrites) are a separate pass; the
-parallelization search below is the part that replaces hand-written
-``in_specs`` and is Unity's headline capability.
+Algebraic substitutions (``substitution.py``'s GraphXfer rules — fusion and
+elimination rewrites) are proposed INSIDE the same Metropolis walk when
+``substitution=True``, so graph rewrites and parallelization assignments are
+explored jointly, as in Unity.
 """
 
 from __future__ import annotations
@@ -80,40 +81,110 @@ def graph_optimize(
     init: Optional[Dict[str, Config]] = None,
     training: bool = True,
     verbose: bool = False,
-) -> Dict[str, Config]:
-    """MCMC search over per-op parallel configs; returns the best strategy."""
+    substitution: bool = False,
+    output_tids: Optional[List[int]] = None,
+    p_sub: float = 0.15,
+):
+    """Joint MCMC search over per-op parallel configs (+ graph rewrites).
+
+    Returns the best strategy; with ``substitution=True`` returns
+    ``(graph, strategy, tid_map)`` where ``tid_map`` maps original tensor
+    ids to the rewritten graph's (identity when no rewrite was accepted).
+    """
     rng = random.Random(seed)
     mm = machine or MachineModel.for_mesh(mesh)
 
-    searchable = []
-    candidates: Dict[str, List[Config]] = {}
-    for node in graph.nodes:
-        in_specs = [graph.spec(t) for t in node.inputs]
-        cands = enumerate_op_configs(node, in_specs, mesh)
-        candidates[node.name] = cands
-        if len(cands) > 1:
-            searchable.append(node.name)
+    def build_candidates(g):
+        searchable, candidates = [], {}
+        for node in g.nodes:
+            in_specs = [g.spec(t) for t in node.inputs]
+            cands = enumerate_op_configs(node, in_specs, mesh)
+            candidates[node.name] = cands
+            if len(cands) > 1:
+                searchable.append(node.name)
+        return searchable, candidates
 
-    def cost_of(strategy) -> float:
-        plan = PCG(graph, mesh, strategy).plan()
+    def cost_of(g, strategy) -> float:
+        plan = PCG(g, mesh, strategy, output_tids=None).plan()
         return simulate(plan, mm, training=training, measured=measured).total
 
-    state = dict(init if init is not None else data_parallel_strategy(graph, mesh))
+    if substitution:
+        from .substitution import apply_match, find_all_matches, standard_rules
+
+        rules = standard_rules()
+        protected = frozenset(output_tids or ())
+    cur_graph = graph
+    tid_map = {t: t for t in range(len(graph.tensor_specs))}
+    searchable, candidates = build_candidates(cur_graph)
+
+    state = dict(init if init is not None
+                 else data_parallel_strategy(cur_graph, mesh))
     try:
-        cur_cost = cost_of(state)
+        cur_cost = cost_of(cur_graph, state)
     except (ValueError, AssertionError):
         state = {}
-        cur_cost = cost_of(state)
-    best, best_cost = dict(state), cur_cost
+        cur_cost = cost_of(cur_graph, state)
+    best = (cur_graph, dict(state), dict(tid_map))
+    best_cost = cur_cost
     if verbose:
         print(f"search: start cost {cur_cost * 1e3:.3f}ms, "
               f"{len(searchable)} searchable ops, budget {budget}")
 
-    if not searchable:
-        return best
-
     accepted = 0
     for it in range(budget):
+        matches = (
+            find_all_matches(cur_graph, rules,
+                             frozenset(tid_map.get(t, -1) for t in protected))
+            if substitution else []
+        )
+        if matches and (rng.random() < p_sub or not searchable):
+            # ---- graph-rewrite proposal (the GraphXfer move) ----------
+            m = rng.choice(matches)
+            try:
+                res = apply_match(cur_graph, m)
+            except (ValueError, AssertionError):
+                continue
+            consumed = {cur_graph.nodes[i].name for i in m.nids}
+            prop_state = {}
+            for name, cfg in state.items():
+                if name in consumed:
+                    new_name = res.name_map.get(name)
+                    if new_name is not None and new_name not in prop_state:
+                        # migrate only configs whose dims the fused op keeps
+                        node = next((n for n in res.graph.nodes
+                                     if n.name == new_name), None)
+                        if node is not None:
+                            in_specs = [res.graph.spec(t) for t in node.inputs]
+                            try:
+                                node.op.apply_config(cfg, in_specs, mesh)
+                                prop_state[new_name] = cfg
+                            except (ValueError, KeyError):
+                                pass
+                else:
+                    prop_state[name] = cfg
+            try:
+                new_cost = cost_of(res.graph, prop_state)
+            except (ValueError, AssertionError):
+                continue
+            if new_cost < cur_cost or rng.random() < math.exp(
+                (cur_cost - new_cost) / max(alpha * cur_cost, 1e-12)
+            ):
+                cur_graph, state, cur_cost = res.graph, prop_state, new_cost
+                tid_map = {t: res.tid_map[n] for t, n in tid_map.items()
+                           if n in res.tid_map}
+                searchable, candidates = build_candidates(cur_graph)
+                accepted += 1
+                if cur_cost < best_cost:
+                    best = (cur_graph, dict(state), dict(tid_map))
+                    best_cost = cur_cost
+                    if verbose:
+                        print(f"  it {it}: best {best_cost * 1e3:.3f}ms "
+                              f"(rewrite {m.rule.name})")
+            continue
+
+        if not searchable:
+            break
+        # ---- parallel-config proposal ---------------------------------
         name = rng.choice(searchable)
         cand = rng.choice(candidates[name])
         if cand == state.get(name, {}):
@@ -124,7 +195,7 @@ def graph_optimize(
         else:
             proposal.pop(name, None)
         try:
-            new_cost = cost_of(proposal)
+            new_cost = cost_of(cur_graph, proposal)
         except (ValueError, AssertionError):
             continue
         # Metropolis criterion (reference: FFModel::optimize MCMC)
@@ -134,7 +205,8 @@ def graph_optimize(
             state, cur_cost = proposal, new_cost
             accepted += 1
             if cur_cost < best_cost:
-                best, best_cost = dict(state), cur_cost
+                best = (cur_graph, dict(state), dict(tid_map))
+                best_cost = cur_cost
                 if verbose:
                     print(f"  it {it}: best {best_cost * 1e3:.3f}ms "
                           f"({name} -> {cand})")
@@ -142,4 +214,6 @@ def graph_optimize(
     if verbose:
         print(f"search: done, best {best_cost * 1e3:.3f}ms "
               f"({accepted}/{budget} accepted)")
-    return best
+    if substitution:
+        return best
+    return best[1]

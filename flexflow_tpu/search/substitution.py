@@ -1,0 +1,384 @@
+"""GraphXfer-style algebraic substitution engine.
+
+Reference: ``src/runtime/substitution.cc`` (~4k LoC of ``GraphXfer`` /
+``OpX`` / ``TensorX`` rewrite machinery) — Unity's *algebraic* half: graph
+rewrites (operator fusions, eliminations) explored JOINTLY with the
+parallelization search.  The TPU re-design is much smaller because XLA
+already fuses elementwise chains inside one program; the rewrites that still
+matter are the ones that change the *graph the search sees*:
+
+* fewer nodes → less per-op dispatch/kernel overhead in the cost model and
+  fewer sharding decisions to search;
+* fused ops with bespoke lowering (ResidualLayerNorm, SigmoidSiluMulti) are
+  the serve-graph shapes the op library already implements — rewriting the
+  training graphs onto them keeps one implementation per pattern.
+
+Machinery: a :class:`GraphXfer` finds :class:`Match`es (source-node ids) and
+:func:`apply_match` rebuilds the graph with the replacement, returning the
+tensor-id remapping (for graph outputs held by the caller), the node-name
+mapping (for strategy migration in the joint search), and the parameter
+mapping (so existing weights transfer — used by the equivalence checker and
+by callers that rewrite after init).  ``graph_optimize`` proposes rewrites
+inside its MCMC walk (see ``search.py``), making the search joint as in
+Unity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.graph import Graph, Node, Tensor
+
+# ---------------------------------------------------------------------------
+# match + rewrite machinery
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Match:
+    rule: "GraphXfer"
+    nids: Tuple[int, ...]  # consumed source-node ids, in graph order
+
+    def __repr__(self):
+        return f"Match({self.rule.name}, nodes={list(self.nids)})"
+
+
+@dataclasses.dataclass
+class RewriteResult:
+    graph: Graph
+    tid_map: Dict[int, int]            # old tid -> new tid (surviving tensors)
+    name_map: Dict[str, str]           # old node name -> new node name
+    # {new_node_name: {new_param_name: (old_node_name, old_param_name)}}
+    param_map: Dict[str, Dict[str, Tuple[str, str]]]
+
+
+class GraphXfer:
+    """One rewrite rule: find matches, build the replacement."""
+
+    name = "?"
+
+    def find(self, graph: Graph, protected=frozenset()) -> List[Match]:
+        raise NotImplementedError
+
+    def build(self, new_graph: Graph, old: Graph, match: Match,
+              tid_map: Dict[int, int]) -> RewriteResult:
+        """Append replacement node(s) to ``new_graph``; extend ``tid_map``
+        with entries for every output tid of the consumed nodes that other
+        nodes may reference.  Returns (name_map, param_map)."""
+        raise NotImplementedError
+
+    # -- shared helpers --------------------------------------------------
+    @staticmethod
+    def _sole_consumer(graph: Graph, tid: int, expect_nid: int) -> bool:
+        cons = graph.consumers(tid)
+        return len(cons) == 1 and cons[0][0].nid == expect_nid
+
+    @staticmethod
+    def _consumers_after(graph: Graph, tid: int, nid: int,
+                         allowed=()) -> bool:
+        """All consumers of ``tid`` are at node positions > nid (or in
+        ``allowed``) — required so the replacement node at position ``nid``
+        dominates them."""
+        return all(
+            n.nid > nid or n.nid in allowed for n, _ in graph.consumers(tid)
+        )
+
+
+def find_all_matches(graph: Graph, rules: Sequence[GraphXfer],
+                     protected=frozenset()) -> List[Match]:
+    out: List[Match] = []
+    claimed = set()
+    for rule in rules:
+        for m in rule.find(graph, protected):
+            if not claimed.intersection(m.nids):
+                out.append(m)
+    return out
+
+
+def apply_match(graph: Graph, match: Match) -> RewriteResult:
+    """Rebuild ``graph`` with ``match`` replaced by its rule's substitute.
+
+    The replacement node is appended at the position of the LAST consumed
+    node (rules guarantee, via ``find``, that no consumer of any replaced
+    tensor sits before that position).
+    """
+    consumed = set(match.nids)
+    last_nid = max(match.nids)
+    g2 = Graph()
+    tid_map: Dict[int, int] = {}
+    for tid in graph.input_tids:
+        tid_map[tid] = g2.add_input(graph.spec(tid)).tid
+
+    result: Optional[RewriteResult] = None
+    for node in graph.nodes:
+        if node.nid in consumed:
+            if node.nid == last_nid:
+                result = match.rule.build(g2, graph, match, tid_map)
+            continue
+        ins = [Tensor(g2, tid_map[t]) for t in node.inputs]
+        outs = g2.add_node(node.op, ins, name=node.name)
+        for old_tid, new_t in zip(node.outputs, outs):
+            tid_map[old_tid] = new_t.tid
+    assert result is not None
+    result.graph = g2
+    result.tid_map = tid_map
+    return result
+
+
+def remap_params(params: Dict[str, Dict], res: RewriteResult,
+                 new_graph: Graph) -> Dict[str, Dict]:
+    """Carry trained weights across a rewrite (identity for untouched
+    nodes, ``param_map`` for the replacement)."""
+    out: Dict[str, Dict] = {}
+    for node in new_graph.nodes:
+        if not node.op.params():
+            continue
+        pm = res.param_map.get(node.name)
+        if pm is None:
+            if node.name in params:
+                out[node.name] = params[node.name]
+        else:
+            out[node.name] = {
+                new_p: params[old_n][old_p]
+                for new_p, (old_n, old_p) in pm.items()
+            }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+class FuseLinearActivation(GraphXfer):
+    """linear (no act) → element_unary(act)  ⇒  linear(activation=act).
+
+    Reference: the ``linear+relu`` GraphXfer in ``substitution.cc`` (and
+    Linear's fused-activation CUDA epilogue).
+    """
+
+    name = "fuse_linear_activation"
+    FUSABLE = ("relu", "gelu", "gelu_exact", "sigmoid", "tanh", "silu", "elu")
+
+    def find(self, graph, protected=frozenset()):
+        out = []
+        for b in graph.nodes:
+            if b.op.type_name != "element_unary" or b.op.fn not in self.FUSABLE:
+                continue
+            prod = graph.producer.get(b.inputs[0])
+            if prod is None:
+                continue
+            a = graph.nodes[prod[0]]
+            if (a.op.type_name == "linear" and a.op.activation is None
+                    and a.outputs[0] not in protected
+                    and self._sole_consumer(graph, a.outputs[0], b.nid)):
+                out.append(Match(self, (a.nid, b.nid)))
+        return out
+
+    def build(self, g2, old, match, tid_map):
+        from ..ops.linear import Linear
+
+        a, b = (old.nodes[i] for i in match.nids)
+        op = Linear(
+            a.op.out_dim, activation=b.op.fn, use_bias=a.op.use_bias,
+            in_dim=a.op.in_dim, dtype=a.op.dtype,
+            kernel_initializer=a.op.kernel_initializer,
+            bias_initializer=a.op.bias_initializer,
+            quantization=a.op.quantization,
+        )
+        (out,) = g2.add_node(op, [Tensor(g2, tid_map[a.inputs[0]])],
+                             name=a.name)
+        tid_map[b.outputs[0]] = out.tid
+        pm = {"kernel": (a.name, "kernel")}
+        if a.op.use_bias:
+            pm["bias"] = (a.name, "bias")
+        return RewriteResult(g2, tid_map, {a.name: a.name, b.name: a.name},
+                             {a.name: pm})
+
+
+class FuseAddNorm(GraphXfer):
+    """add(x, r) → layer_norm/rms_norm  ⇒  Residual{Layer,RMS}Norm.
+
+    Reference: ``residual_layer_norm.cu`` / ``residual_rms_norm.cu`` — the
+    fused residual+norm ops the serve graphs use; this rewrite gives the
+    training graphs the same fusion.  The fused op also emits the residual
+    sum, so other consumers of the add output are remapped to it.
+    """
+
+    name = "fuse_add_norm"
+
+    def find(self, graph, protected=frozenset()):
+        out = []
+        for b in graph.nodes:
+            if b.op.type_name not in ("layer_norm", "rms_norm"):
+                continue
+            prod = graph.producer.get(b.inputs[0])
+            if prod is None:
+                continue
+            a = graph.nodes[prod[0]]
+            if a.op.type_name != "element_binary" or a.op.fn != "add":
+                continue
+            # no broadcasting: residual ops require equal shapes
+            if old_specs_differ(graph, a):
+                continue
+            # add output may have later consumers (remapped to the fused
+            # op's residual-sum output), but none between a and b
+            if not self._consumers_after(graph, a.outputs[0], b.nid,
+                                         allowed={b.nid}):
+                continue
+            out.append(Match(self, (a.nid, b.nid)))
+        return out
+
+    def build(self, g2, old, match, tid_map):
+        from ..ops.norm import ResidualLayerNorm, ResidualRMSNorm
+
+        a, b = (old.nodes[i] for i in match.nids)
+        if b.op.type_name == "layer_norm":
+            op = ResidualLayerNorm(
+                b.op.dim, elementwise_affine=b.op.elementwise_affine,
+                eps=b.op.eps, use_bias=b.op.use_bias, dtype=b.op.dtype,
+            )
+            pm = {}
+            if b.op.elementwise_affine:
+                pm["gamma"] = (b.name, "gamma")
+                if b.op.use_bias:
+                    pm["beta"] = (b.name, "beta")
+        else:
+            op = ResidualRMSNorm(b.op.dim, eps=b.op.eps, dtype=b.op.dtype)
+            pm = {"gamma": (b.name, "gamma")}
+        ins = [Tensor(g2, tid_map[t]) for t in a.inputs]
+        sum_out, normed = g2.add_node(op, ins, name=b.name)
+        tid_map[a.outputs[0]] = sum_out.tid
+        tid_map[b.outputs[0]] = normed.tid
+        return RewriteResult(g2, tid_map, {a.name: b.name, b.name: b.name},
+                             {b.name: pm} if pm else {})
+
+
+class FuseSiluMul(GraphXfer):
+    """silu(gate) * up  ⇒  SigmoidSiluMulti(gate, up) (the SwiGLU junction).
+
+    Reference: ``sigmoid_silu_multi.cu``.
+    """
+
+    name = "fuse_silu_mul"
+
+    def find(self, graph, protected=frozenset()):
+        out = []
+        for b in graph.nodes:
+            if b.op.type_name != "element_binary" or b.op.fn != "mul":
+                continue
+            for slot in (0, 1):
+                prod = graph.producer.get(b.inputs[slot])
+                if prod is None:
+                    continue
+                a = graph.nodes[prod[0]]
+                if (a.op.type_name == "element_unary" and a.op.fn == "silu"
+                        and a.outputs[0] not in protected
+                        and self._sole_consumer(graph, a.outputs[0], b.nid)):
+                    out.append(Match(self, (a.nid, b.nid)))
+                    break
+        return out
+
+    def build(self, g2, old, match, tid_map):
+        from ..ops.norm import SigmoidSiluMulti
+
+        a, b = (old.nodes[i] for i in match.nids)
+        gate = a.inputs[0]
+        up = b.inputs[1] if b.inputs[0] == a.outputs[0] else b.inputs[0]
+        (out,) = g2.add_node(
+            SigmoidSiluMulti(),
+            [Tensor(g2, tid_map[gate]), Tensor(g2, tid_map[up])],
+            name=b.name,
+        )
+        tid_map[b.outputs[0]] = out.tid
+        return RewriteResult(g2, tid_map, {a.name: b.name, b.name: b.name}, {})
+
+
+class EliminateIdentity(GraphXfer):
+    """element_unary(identity) / scalar_multiply(1.0)  ⇒  (removed)."""
+
+    name = "eliminate_identity"
+
+    def find(self, graph, protected=frozenset()):
+        out = []
+        for a in graph.nodes:
+            if a.op.type_name != "element_unary":
+                continue
+            if not (a.op.fn == "identity"
+                    or (a.op.fn == "scalar_multiply" and a.op.scalar == 1.0)):
+                continue
+            if a.outputs[0] in protected:
+                continue
+            out.append(Match(self, (a.nid,)))
+        return out
+
+    def build(self, g2, old, match, tid_map):
+        a = old.nodes[match.nids[0]]
+        tid_map[a.outputs[0]] = tid_map[a.inputs[0]]
+        return RewriteResult(g2, tid_map, {}, {})
+
+
+def old_specs_differ(graph: Graph, node: Node) -> bool:
+    s0 = graph.spec(node.inputs[0])
+    return any(graph.spec(t).shape != s0.shape for t in node.inputs[1:])
+
+
+def standard_rules() -> List[GraphXfer]:
+    return [
+        FuseLinearActivation(),
+        FuseAddNorm(),
+        FuseSiluMul(),
+        EliminateIdentity(),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# equivalence checker
+# ---------------------------------------------------------------------------
+
+
+def check_equivalence(
+    old_graph: Graph,
+    res: RewriteResult,
+    out_tids: Sequence[int],
+    mesh,
+    seed: int = 0,
+    atol: float = 1e-4,
+    rtol: float = 1e-4,
+) -> None:
+    """Numerically verify a rewrite: same params + same random inputs ⇒ same
+    outputs (single-device forward of both graphs).  Raises on mismatch."""
+    import jax.numpy as jnp
+
+    from ..core.interpreter import build_forward, init_params
+    from ..core.pcg import PCG
+
+    plan_a = PCG(old_graph, mesh, {}, output_tids=list(out_tids)).plan()
+    new_out = [res.tid_map[t] for t in out_tids]
+    plan_b = PCG(res.graph, mesh, {}, output_tids=new_out).plan()
+
+    params_a = init_params(old_graph, plan_a, jax.random.PRNGKey(seed))
+    params_b = remap_params(params_a, res, res.graph)
+
+    rng = np.random.RandomState(seed)
+    feed_a, feed_b = {}, {}
+    for tid in old_graph.input_tids:
+        spec = old_graph.spec(tid)
+        if jnp.issubdtype(jnp.dtype(spec.dtype), jnp.integer):
+            arr = rng.randint(0, 2, size=spec.shape)
+        else:
+            arr = rng.randn(*spec.shape)
+        feed_a[tid] = jnp.asarray(arr, spec.dtype)
+        feed_b[res.tid_map[tid]] = feed_a[tid]
+
+    outs_a = build_forward(plan_a)(params_a, feed_a)
+    outs_b = build_forward(plan_b)(params_b, feed_b)
+    for oa, ob in zip(outs_a, outs_b):
+        np.testing.assert_allclose(
+            np.asarray(oa, np.float32), np.asarray(ob, np.float32),
+            atol=atol, rtol=rtol,
+        )
