@@ -87,13 +87,31 @@ def time_fn(fn, args, iters: int = 6, n_lo: int = 32,
     def body(carry, _):
         lvs, acc = carry
         outs = fn(*jax.tree.unflatten(treedef, lvs))
-        f_outs = [o for o in jax.tree.leaves(outs)
-                  if hasattr(o, "dtype") and o.dtype.kind == "f"]
+        # dtype.kind == 'f' misses bfloat16 (numpy kind 'V'), which would
+        # let XLA delete a bf16 matmul entirely (measures ~0); use
+        # jnp.inexact, and when an op has no inexact output at all (e.g.
+        # argmax) fold the integer outputs in so the kernel still survives.
+        all_outs = [o for o in jax.tree.leaves(outs) if hasattr(o, "dtype")]
+        f_outs = [o for o in all_outs
+                  if jnp.issubdtype(o.dtype, jnp.inexact)]
+        if not f_outs:
+            f_outs = all_outs
         dep = sum((jnp.sum(o.astype(jnp.float32)) for o in f_outs),
                   jnp.float32(0)) * 1e-30
         new = [l + dep.astype(l.dtype)
-               if hasattr(l, "dtype") and l.dtype.kind == "f" else l
+               if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact)
+               else l
                for l in lvs]
+        if not any(hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact)
+                   for l in lvs):
+            # all-int inputs: without a carry dependency fn is loop-invariant
+            # and XLA hoists it out of the scan.  dep is ~0 at runtime, so
+            # adding its int cast leaves index semantics intact.  (Skip bool
+            # leaves: bool(dep≈1e-30) is True and bool+bool saturates.)
+            new = [l + dep.astype(l.dtype)
+                   if hasattr(l, "dtype")
+                   and jnp.issubdtype(l.dtype, jnp.integer) else l
+                   for l in new]
         return (new, acc + dep), None
 
     @functools.partial(jax.jit, static_argnames=("n",))

@@ -131,13 +131,20 @@ def graph_optimize(
               f"{len(searchable)} searchable ops, budget {budget}")
 
     accepted = 0
+    # Matches are a function of the graph alone, so compute them lazily
+    # (only when the rewrite move is drawn) and cache until a rewrite is
+    # accepted — recomputing per iteration scanned all nodes x all rules
+    # on the common (parallel-config) path.
+    cached_matches = None
     for it in range(budget):
-        matches = (
-            find_all_matches(cur_graph, rules,
-                             frozenset(tid_map.get(t, -1) for t in protected))
-            if substitution else []
-        )
-        if matches and (rng.random() < p_sub or not searchable):
+        matches = []
+        if substitution and (not searchable or rng.random() < p_sub):
+            if cached_matches is None:
+                cached_matches = find_all_matches(
+                    cur_graph, rules,
+                    frozenset(tid_map.get(t, -1) for t in protected))
+            matches = cached_matches
+        if matches:
             # ---- graph-rewrite proposal (the GraphXfer move) ----------
             m = rng.choice(matches)
             try:
@@ -173,6 +180,7 @@ def graph_optimize(
                 tid_map = {t: res.tid_map[n] for t, n in tid_map.items()
                            if n in res.tid_map}
                 searchable, candidates = build_candidates(cur_graph)
+                cached_matches = None
                 accepted += 1
                 if cur_cost < best_cost:
                     best = (cur_graph, dict(state), dict(tid_map))

@@ -91,11 +91,12 @@ class GraphXfer:
 def find_all_matches(graph: Graph, rules: Sequence[GraphXfer],
                      protected=frozenset()) -> List[Match]:
     out: List[Match] = []
-    claimed = set()
+    # No disjointness filtering: the MCMC proposer applies exactly one match
+    # per iteration, so overlapping matches are legitimate alternatives —
+    # filtering them would hide rewrites behind rule ordering.
     for rule in rules:
         for m in rule.find(graph, protected):
-            if not claimed.intersection(m.nids):
-                out.append(m)
+            out.append(m)
     return out
 
 

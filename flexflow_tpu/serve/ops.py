@@ -280,8 +280,11 @@ class IncMultiHeadSelfAttention(Op):
         """
         t, h, d = updates.shape
         upd = updates.astype(cache.dtype)
-        rows = rows.astype(jnp.int32)
-        pos = pos.astype(jnp.int32)
+        # Clip so both paths share the DUS path's clamped out-of-range
+        # semantics: PROMISE_IN_BOUNDS on the scatter would otherwise be
+        # undefined behavior for a hand-built BatchConfig with bad positions.
+        rows = jnp.clip(rows.astype(jnp.int32), 0, cache.shape[0] - 1)
+        pos = jnp.clip(pos.astype(jnp.int32), 0, cache.shape[2] - 1)
         if t > 32:
             idx = jnp.stack([rows, pos], axis=-1)
             dnums = jax.lax.ScatterDimensionNumbers(
@@ -305,7 +308,8 @@ class IncMultiHeadSelfAttention(Op):
         """``[T, H, D] = cache[rows[t], :, pos[t]]`` (same no-transpose
         reasoning as :meth:`_scatter_rows_pos`)."""
         idx = jnp.stack(
-            [rows.astype(jnp.int32), pos.astype(jnp.int32)], axis=-1
+            [jnp.clip(rows.astype(jnp.int32), 0, cache.shape[0] - 1),
+             jnp.clip(pos.astype(jnp.int32), 0, cache.shape[2] - 1)], axis=-1
         )
         dnums = jax.lax.GatherDimensionNumbers(
             offset_dims=(1, 2),
@@ -380,8 +384,9 @@ class IncMultiHeadSelfAttention(Op):
         kc, vc, sk, sv = state["k"], state["v"], state["sk"], state["sv"]
         nreq = kc.shape[0] - 1
         rows = jnp.where(bc.commit_request_index >= 0, bc.commit_request_index, nreq)
-        src = jnp.clip(bc.commit_src_spec_index, 0, sk.shape[2] - 1)
-        dst = jnp.clip(bc.commit_dst_position, 0, kc.shape[2] - 1)
+        # _scatter/_gather_rows_pos clip rows/pos internally
+        src = bc.commit_src_spec_index
+        dst = bc.commit_dst_position
         kc = self._scatter_rows_pos(
             kc, rows, dst, self._gather_rows_pos(sk, rows, src)
         )
