@@ -35,7 +35,7 @@ PEAK_HBM = {  # bytes/sec, per chip
 
 
 def build_im(use_pallas, layers, hidden, heads, kv, inter, vocab,
-             max_requests, max_seq):
+             max_requests, max_seq, max_tokens=None, max_spec=0, topk=0):
     import jax
 
     from flexflow_tpu import FFConfig, FFModel
@@ -52,12 +52,14 @@ def build_im(use_pallas, layers, hidden, heads, kv, inter, vocab,
         num_attention_heads=heads, num_key_value_heads=kv,
         dtype="bfloat16",
     )
+    max_tokens = max_tokens or max_requests
     mesh = make_mesh({"tp": 1}, jax.devices()[:1])
     ff = FFModel(FFConfig(), mesh=mesh)
-    logits = build_model(ff, cfg, max_tokens=max_requests)
+    logits = build_model(ff, cfg, max_tokens=max_tokens)
     im = InferenceManager(
-        ff, max_requests=max_requests, max_tokens_per_batch=max_requests,
-        max_seq_len=max_seq, outputs=logits, use_pallas=use_pallas,
+        ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
+        max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
+        outputs=logits, use_pallas=use_pallas,
     )
     im.init_operators_inference(rng=jax.random.PRNGKey(0), dtype="bfloat16")
     return im
@@ -80,12 +82,12 @@ def bench_decode_scan(im, ctx, n_lo=8, n_hi=40, n_outer=4):
     def best_of(steps):
         # np.asarray (not block_until_ready): a host read is the only sync
         # that reliably waits for device completion on tunneled runtimes
-        tokens, _ = im.decode_scan(bc0, steps)  # compile + warm
+        tokens, _, _ = im.decode_scan(bc0, steps)  # compile + warm
         np.asarray(tokens)
         best = float("inf")
         for _ in range(n_outer):
             t0 = time.perf_counter()
-            tokens, _ = im.decode_scan(bc0, steps)
+            tokens, _, _ = im.decode_scan(bc0, steps)
             np.asarray(tokens)
             best = min(best, time.perf_counter() - t0)
         return best
@@ -94,13 +96,22 @@ def bench_decode_scan(im, ctx, n_lo=8, n_hi=40, n_outer=4):
 
 
 def step_bytes(im, ctx):
-    """Bytes that must cross HBM per decode step: all weights once + the
-    causally-live KV prefix (read) + the new KV entries (write)."""
+    """Bytes that must cross HBM per decode step: weights once + the
+    causally-live KV prefix (read) + the new KV entries (write).
+
+    The token-embedding table is NOT read in full — a decode step gathers
+    one row per token — so it contributes R rows, not the whole table
+    (counting it fully put hbm_frac above 1.0 in BENCH_r02, which is
+    physically impossible; VERDICT r2 weak #4)."""
     import jax
 
-    p_bytes = sum(
-        x.size * x.dtype.itemsize for x in jax.tree.leaves(im.params)
-    )
+    p_bytes = 0
+    for name, group in im.params.items():
+        for pname, x in group.items():
+            if "embed_tokens" in name:
+                p_bytes += im.max_requests * x.shape[-1] * x.dtype.itemsize
+            else:
+                p_bytes += x.size * x.dtype.itemsize
     kv_bytes = 0
     for bufs in im.state.values():
         k = bufs["k"]  # [R+1, KV, S, D]
@@ -111,10 +122,119 @@ def step_bytes(im, ctx):
     return p_bytes + kv_bytes
 
 
-def bench_mlp_train(steps: int = 50, batch: int = 64):
+def prefill_im(im, prompts):
+    """Chunked host prefill; returns the first generated token per request.
+
+    Steps are dispatched asynchronously (no per-chunk sync); only the chunks
+    carrying a prompt's final position are read back, at the end.
+    """
+    from flexflow_tpu.serve.batch_config import BatchConfig
+
+    cap = im.max_tokens
+    flat = [(tok, r, p)
+            for r, pr in enumerate(prompts) for p, tok in enumerate(pr)]
+    seq_lens = [len(p) for p in prompts]
+    pending = {}  # rid -> (chunk result, flat index within chunk)
+    for at in range(0, len(flat), cap):
+        chunk = flat[at: at + cap]
+        bc = BatchConfig.build(
+            [c[0] for c in chunk], [c[1] for c in chunk],
+            [c[2] for c in chunk], seq_lens,
+            max_tokens=cap, max_requests=im.max_requests,
+        )
+        res = im.step(bc)
+        for i, (_, r, p) in enumerate(chunk):
+            if p == len(prompts[r]) - 1:
+                pending[r] = (res, i)
+    return [int(np.asarray(pending[r][0].token_ids)[pending[r][1]])
+            for r in range(len(prompts))]
+
+
+def bench_spec_decode(ctx=1800, width=1, depth=3, n_lo=4, n_hi=20,
+                      n_outer=3):
+    """SpecInfer TPOT on device (north-star #2 currency).
+
+    7B-shaped 8-layer LLM slice + 2-layer draft sharing the LLM's first two
+    layers; the LLM's upper layers have zeroed residual contributions
+    (o_proj/down_proj = 0) so the draft predicts the LLM's argmax exactly.
+    Acceptance is therefore 1.0 BY CONSTRUCTION — an upper bound, reported
+    as such — but every measured cost is real: the zeroed weights still
+    multiply, the tree-verify step scores R*(1+width*depth) tokens through
+    all 8 layers, and the macro-step runs fully on device
+    (serve/spec_scan.py).  Timing is the slope between two scan lengths, so
+    the tunnel's dispatch latency cancels.
+    """
     import jax
     import jax.numpy as jnp
 
+    from flexflow_tpu.serve.spec_scan import SpecDecodeScan
+
+    R = 8
+    P = 1 + width * depth
+    max_seq = 2432  # ctx + headroom for the timed macro-steps
+    shape = dict(hidden=4096, heads=32, kv=32, inter=11008, vocab=32000)
+    llm = build_im(use_pallas=True, layers=8, max_requests=R,
+                   max_seq=max_seq, max_tokens=R * P, max_spec=8, **shape)
+    for i in range(2, 8):
+        att = llm.params[f"model.layers.{i}.self_attn"]
+        att["o_proj"] = jnp.zeros_like(att["o_proj"])
+        mlp = llm.params[f"model.layers.{i}.mlp.down_proj"]
+        mlp["kernel"] = jnp.zeros_like(mlp["kernel"])
+    ssm = build_im(use_pallas=True, layers=2, max_requests=R,
+                   max_seq=max_seq, max_tokens=R * (depth + 1), max_spec=8,
+                   topk=max(width, 1), **shape)
+    for name in ssm.params:
+        ssm.params[name] = llm.params[name]  # shared prefix + norm + head
+
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(1, 31999, size=(R, ctx)).tolist()
+    firsts = prefill_im(llm, prompts)
+    prefill_im(ssm, prompts)
+
+    sc = SpecDecodeScan(llm, ssm, width=width, depth=depth)
+    carry0 = sc.init_carry(firsts, [ctx] * R, [ctx] * R, [False] * R)
+    committed = []
+
+    def best_of(n_macro):
+        nonlocal carry0
+        emitted, carry0 = sc.run(carry0, n_macro)  # compile + warm
+        committed.append(np.asarray(emitted))
+        best = float("inf")
+        for _ in range(n_outer):
+            t0 = time.perf_counter()
+            emitted, carry0 = sc.run(carry0, n_macro)
+            np.asarray(emitted)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo = best_of(n_lo)
+    t_hi = best_of(n_hi)
+    per_macro = (t_hi - t_lo) / (n_hi - n_lo)
+    em = np.concatenate([c.reshape(-1, R, depth + 1) for c in committed])
+    toks_per_slot_macro = float((em >= 0).sum()) / (em.shape[0] * R)
+    acceptance = (toks_per_slot_macro - 1.0) / depth
+    return {
+        "spec_tpot_ms": round(per_macro / toks_per_slot_macro * 1e3, 3),
+        "spec_macro_ms": round(per_macro * 1e3, 3),
+        "spec_tokens_per_macro": round(toks_per_slot_macro, 3),
+        "spec_acceptance": round(acceptance, 3),
+        "spec_config": f"w={width} d={depth} bs={R} ctx={ctx}, "
+                       "constructed perfect draft (acceptance is the upper "
+                       "bound; device costs are real)",
+    }
+
+
+def bench_mlp_train(batch: int = 64):
+    """MNIST-MLP train throughput: ON-DEVICE ``lax.scan`` over steps, slope
+    between two scan lengths (same method as the decode bench).
+
+    Timing history (VERDICT r2 weak #3): BENCH_r01's 1.1M samples/s timed
+    async dispatch only (the host queued steps without waiting) — wrong.
+    BENCH_r02's 29.7k samples/s synced once per 50 host-dispatched steps —
+    honest about completion but dominated by the tunnel's ~1.4ms/step
+    dispatch, not device time.  This version scans steps on device, so the
+    number is device throughput; the slope cancels the ~100ms sync.
+    """
     from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
 
     model = FFModel(FFConfig(batch_size=batch, learning_rate=0.05))
@@ -127,20 +247,129 @@ def bench_mlp_train(steps: int = 50, batch: int = 64):
     rng = np.random.RandomState(0)
     X = rng.randn(batch, 784).astype(np.float32)
     y = rng.randint(0, 10, size=batch).astype(np.int32)
+    return batch / _train_step_time(model, X, y)
+
+
+def _train_step_time(model, X, y, iters=4):
+    """Seconds/step of a compiled training model: on-device ``lax.scan`` over
+    steps, slope between two scan lengths (the ~100ms tunnel sync and the
+    per-call dispatch both cancel in the slope).  Scan lengths ADAPT to the
+    step cost so the slope signal is ~0.25s — small fused steps are µs-scale
+    and a fixed length drowns in the tunnel's ms-scale sync jitter."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
     tid = model.graph.input_tids[0]
     xb, yb = jnp.asarray(X), jnp.asarray(y)
     key = jax.random.PRNGKey(0)
 
-    p, s = model.params, model.opt_state
-    p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
-    np.asarray(loss)
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def train_n(p, s, n):
+        def body(c, _):
+            p, s = c
+            p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
+            return (p, s), loss
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
-    np.asarray(loss)  # the last loss depends on every queued step
-    dt = time.perf_counter() - t0
-    return steps * batch / dt
+        (p, s), losses = jax.lax.scan(body, (p, s), None, length=n)
+        return losses[-1]
+
+    def best_of(n, k=iters):
+        np.asarray(train_n(model.params, model.opt_state, n))  # compile+warm
+        best = float("inf")
+        for _ in range(k):
+            t0 = time.perf_counter()
+            np.asarray(train_n(model.params, model.opt_state, n))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    est = max((best_of(500, k=2) - 0.05) / 500, 2e-7)
+    n_hi = int(min(max(0.25 / est, 1000), 30000))
+    n_lo = n_hi // 10
+    return (best_of(n_hi) - best_of(n_lo)) / (n_hi - n_lo)
+
+
+def bench_cost_model():
+    """Rank-correlation of simulated vs measured step times (VERDICT r2
+    item 4): does the cost model order real workloads the way the chip does?
+
+    Multi-chip strategies can't be wall-clocked on one chip, so fidelity is
+    validated on what CAN be measured here: six single-device training
+    graphs with diverse op mixes/shapes, simulated with the measured-probe
+    cache + roofline, vs real on-device step time.
+    """
+    import os
+
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, make_mesh
+    from flexflow_tpu.models.transformer import build_transformer_classifier
+    from flexflow_tpu.search.machine_model import MachineModel
+    from flexflow_tpu.search.measure import CostCache
+    from flexflow_tpu.search.simulator import simulate
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    mm = MachineModel.for_mesh(mesh, spec_name="v5e")
+    here = os.path.dirname(os.path.abspath(__file__))
+    costs = CostCache(os.path.join(here, "artifacts", "tpu_costs_v5e.json"))
+    rng = np.random.RandomState(0)
+
+    def mlp(batch, widths):
+        model = FFModel(FFConfig(batch_size=batch), mesh=mesh)
+        x = model.create_tensor((batch, 784))
+        h = x
+        for w in widths:
+            h = model.dense(h, w, activation="relu")
+        model.softmax(model.dense(h, 10))
+        model.compile(optimizer=SGDOptimizer(lr=0.01))
+        return model, rng.randn(batch, 784).astype(np.float32), \
+            rng.randint(0, 10, size=batch).astype(np.int32)
+
+    def tfm(batch, seq, hidden, heads, ff):
+        model = build_transformer_classifier(
+            mesh=mesh, batch=batch, seq=seq, num_layers=2, hidden_dim=hidden,
+            num_heads=heads, ff_dim=ff, num_classes=16,
+        )
+        model.compile(optimizer=SGDOptimizer(lr=0.01))
+        return model, rng.randn(batch, seq, hidden).astype(np.float32), \
+            rng.randint(0, 16, size=batch).astype(np.int32)
+
+    variants = {
+        "mlp_small": lambda: mlp(64, [512, 512]),
+        "mlp_wide": lambda: mlp(64, [2048, 2048]),
+        "mlp_deep": lambda: mlp(64, [512] * 6),
+        "mlp_batch": lambda: mlp(1024, [1024, 1024]),
+        "tfm_small": lambda: tfm(8, 64, 256, 8, 1024),
+        "tfm_wide": lambda: tfm(8, 128, 512, 8, 2048),
+    }
+    sim_ms, meas_ms = {}, {}
+    for name, build in variants.items():
+        model, X, y = build()
+        sim_ms[name] = simulate(
+            model.plan, mm, training=True, measured=costs
+        ).total * 1e3
+        meas_ms[name] = _train_step_time(model, X, y) * 1e3
+        del model
+
+    names = list(variants)
+    sim = np.array([sim_ms[n] for n in names])
+    mea = np.array([meas_ms[n] for n in names])
+
+    def ranks(a):
+        r = np.empty(len(a))
+        r[np.argsort(a)] = np.arange(len(a))
+        return r
+
+    rs, rm = ranks(sim), ranks(mea)
+    corr = float(np.corrcoef(rs, rm)[0, 1])
+    return {
+        "cost_model_rank_corr": round(corr, 3),
+        "cost_model_points": {
+            n: {"sim_ms": round(sim_ms[n], 3), "meas_ms": round(meas_ms[n], 3)}
+            for n in names
+        },
+    }
 
 
 def searched_vs_dp_fields():
@@ -182,6 +411,8 @@ def main():
     gather_tpot = bench_decode_scan(im, ctx)
     del im
 
+    spec = bench_spec_decode(ctx=ctx)
+
     kind = jax.devices()[0].device_kind
     peak = PEAK_HBM.get(kind)  # None on unknown hardware -> hbm_frac null
     n = shape["max_requests"]
@@ -198,7 +429,13 @@ def main():
         "config": "llama2-7b-shape 8-layer slice, bf16, bs=8, ctx=1800",
         "device": kind,
         "mnist_mlp_train_samples_per_sec": round(mlp, 1),
+        "mnist_timing_note": "on-device scan slope (device throughput); "
+                             "r01 measured async dispatch (wrong), r02 "
+                             "included ~1.4ms/step host dispatch",
     }
+    doc.update(spec)
+    doc["spec_vs_incr"] = round(pallas_tpot * 1e3 / spec["spec_tpot_ms"], 3)
+    doc.update(bench_cost_model())
     doc.update(searched_vs_dp_fields())
     print(json.dumps(doc))
 

@@ -64,9 +64,22 @@ def main():
         graph, mesh, budget=300, machine=v5e, measured=costs, seed=0, init=dp,
     )
 
+    # joint Unity search: same walk, graph rewrites enabled (the wallclock
+    # comparison below keeps the parallel-only strategy so the hand-built
+    # and searched graphs stay identical)
+    joint_graph, joint_strategy, _ = graph_optimize(
+        graph, mesh, budget=300, machine=v5e, measured=costs, seed=0,
+        init=dp, substitution=True,
+        output_tids=[graph.nodes[-1].outputs[-1]],
+    )
+    rewrites_accepted = len(graph.nodes) - len(joint_graph.nodes)
+
     sim_dp = simulate(PCG(graph, mesh, dp).plan(), v5e, measured=costs).total
     sim_se = simulate(PCG(graph, mesh, searched).plan(), v5e,
                       measured=costs).total
+    sim_joint = simulate(
+        PCG(joint_graph, mesh, joint_strategy,
+            output_tids=None).plan(), v5e, measured=costs).total
 
     strat_path = os.path.join(HERE, "artifacts",
                               "searched_transformer_strategy.json")
@@ -100,6 +113,8 @@ def main():
 
     print(json.dumps({
         "searched_vs_dp_sim": round(sim_dp / sim_se, 3),
+        "joint_vs_dp_sim": round(sim_dp / sim_joint, 3),
+        "rewrites_accepted": rewrites_accepted,
         "searched_vs_dp_wallclock": round(wc_dp / wc_se, 3),
         "dp_sim_ms": round(sim_dp * 1e3, 3),
         "searched_sim_ms": round(sim_se * 1e3, 3),

@@ -1,0 +1,11 @@
+"""Import frontends: foreign model definitions -> FFModel graphs.
+
+Reference: ``python/flexflow/torch`` (fx tracing), ``python/flexflow/keras``
+and ``python/flexflow/onnx`` in the reference tree.  torch.fx is the
+implemented one (the reference's example ports are torch-first); Keras/ONNX
+remain out of scope this round.
+"""
+
+from .torch_fx import from_torch
+
+__all__ = ["from_torch"]

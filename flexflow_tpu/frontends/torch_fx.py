@@ -1,0 +1,232 @@
+"""torch.fx import frontend: trace an ``nn.Module`` into an FFModel graph.
+
+Reference: ``python/flexflow/torch/model.py`` (the reference's fx-based
+PyTorch frontend — ``torch.fx.symbolic_trace`` each module, walk the fx
+graph node by node, emit the matching FFModel layer call, then load the
+torch weights).  Same approach here; the emitted graph is the repo-native
+Layer graph, so everything downstream (Unity search, PCG planning, GSPMD
+execution) applies to imported models unchanged.
+
+Scope: the module/function/method vocabulary the reference's example ports
+use (Linear, activations, LayerNorm, Embedding, Dropout, MultiheadAttention,
+elementwise add/mul, reshape/flatten, softmax).  Unsupported nodes raise
+with the fx target name so gaps are explicit, never silent.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def _to_np(t):
+    return t.detach().cpu().numpy()
+
+
+class _Importer:
+    def __init__(self, gm, model: FFModel, input_shapes, dtype):
+        self.gm = gm
+        self.model = model
+        self.input_shapes = list(input_shapes)
+        self.dtype = dtype
+        self.env: Dict = {}
+        self.weights: Dict[str, Dict[str, np.ndarray]] = {}
+        self._n_inputs = 0
+
+    # -- node handlers ---------------------------------------------------
+    def placeholder(self, node):
+        shape = self.input_shapes[self._n_inputs]
+        self._n_inputs += 1
+        dtype = shape[1] if (isinstance(shape, tuple) and len(shape) == 2
+                             and isinstance(shape[1], str)) else None
+        if dtype:
+            self.env[node.name] = self.model.create_tensor(shape[0], dtype)
+        else:
+            self.env[node.name] = self.model.create_tensor(shape, self.dtype)
+
+    def call_module(self, node):
+        import torch.nn as nn
+
+        mod = self.gm.get_submodule(node.target)
+        x = [self.env[a.name] for a in node.args]
+        name = node.target.replace(".", "_")
+        m = self.model
+        if isinstance(mod, nn.Linear):
+            out = m.dense(x[0], mod.out_features,
+                          use_bias=mod.bias is not None, name=name)
+            w = {"kernel": _to_np(mod.weight).T}  # torch [out,in] -> [in,out]
+            if mod.bias is not None:
+                w["bias"] = _to_np(mod.bias)
+            self.weights[name] = w
+        elif isinstance(mod, nn.Embedding):
+            out = m.embedding(x[0], mod.num_embeddings, mod.embedding_dim,
+                              name=name)
+            self.weights[name] = {"weight": _to_np(mod.weight)}
+        elif isinstance(mod, nn.LayerNorm):
+            out = m.layer_norm(
+                x[0], elementwise_affine=mod.elementwise_affine,
+                eps=mod.eps, use_bias=mod.bias is not None, name=name)
+            if mod.elementwise_affine:
+                w = {"gamma": _to_np(mod.weight)}
+                if mod.bias is not None:
+                    w["beta"] = _to_np(mod.bias)
+                self.weights[name] = w
+        elif isinstance(mod, nn.MultiheadAttention):
+            out = self._mha(node, mod, name)
+        elif isinstance(mod, nn.Dropout):
+            out = m.dropout(x[0], mod.p, name=name)
+        elif isinstance(mod, nn.ReLU):
+            out = m.relu(x[0], name=name)
+        elif isinstance(mod, nn.GELU):
+            out = m.gelu(x[0], name=name)
+        elif isinstance(mod, nn.SiLU):
+            out = m.silu(x[0], name=name)
+        elif isinstance(mod, nn.Sigmoid):
+            out = m.sigmoid(x[0], name=name)
+        elif isinstance(mod, nn.Tanh):
+            out = m.tanh(x[0], name=name)
+        elif isinstance(mod, nn.Softmax):
+            out = m.softmax(x[0], axis=mod.dim if mod.dim is not None else -1,
+                            name=name)
+        elif isinstance(mod, nn.Flatten):
+            out = m.flat(x[0], name=name)
+        elif isinstance(mod, nn.Identity):
+            out = x[0]
+        else:
+            raise NotImplementedError(
+                f"torch.fx import: unsupported module {type(mod).__name__} "
+                f"at node {node.target!r}"
+            )
+        self.env[node.name] = out
+
+    def _mha(self, node, mod, name):
+        import torch.nn as nn  # noqa: F401
+
+        if not mod.batch_first:
+            raise NotImplementedError(
+                "nn.MultiheadAttention import requires batch_first=True"
+            )
+        q, k, v = (self.env[a.name] for a in node.args[:3])
+        e, h = mod.embed_dim, mod.num_heads
+        hd = e // h
+        out = self.model.multihead_attention(
+            q, k, v, e, h, use_bias=mod.in_proj_bias is not None, name=name)
+        if mod.in_proj_weight is not None:
+            wq, wk, wv = np.split(_to_np(mod.in_proj_weight), 3, axis=0)
+        else:
+            wq = _to_np(mod.q_proj_weight)
+            wk = _to_np(mod.k_proj_weight)
+            wv = _to_np(mod.v_proj_weight)
+        w = {
+            # torch [e_out, e_in] -> ours [e_in, h, hd]
+            "wq": wq.T.reshape(e, h, hd),
+            "wk": wk.T.reshape(e, h, hd),
+            "wv": wv.T.reshape(e, h, hd),
+            "wo": _to_np(mod.out_proj.weight).T.reshape(h, hd, e),
+        }
+        if mod.in_proj_bias is not None:
+            bq, bk, bv = np.split(_to_np(mod.in_proj_bias), 3, axis=0)
+            w.update(
+                bq=bq.reshape(h, hd), bk=bk.reshape(h, hd),
+                bv=bv.reshape(h, hd), bo=_to_np(mod.out_proj.bias),
+            )
+        self.weights[name] = w
+        return (out, None)  # torch MHA returns (output, attn_weights)
+
+    _FN_UNARY = None  # set lazily (needs torch imported)
+
+    def call_function(self, node):
+        import torch
+        import torch.nn.functional as F
+
+        m = self.model
+        args = [self.env[a.name] if hasattr(a, "name") and a.name in self.env
+                else a for a in node.args]
+        fn = node.target
+        name = node.name
+        if fn is operator.getitem:
+            # tuple-returning modules (nn.MultiheadAttention -> (out, attn))
+            self.env[node.name] = args[0][args[1]]
+            return
+        if fn in (operator.add, torch.add):
+            out = m.add(args[0], args[1], name=name)
+        elif fn in (operator.mul, torch.mul):
+            out = m.multiply(args[0], args[1], name=name)
+        elif fn in (torch.relu, F.relu):
+            out = m.relu(args[0], name=name)
+        elif fn is F.gelu:
+            out = m.gelu(args[0], name=name)
+        elif fn is F.silu:
+            out = m.silu(args[0], name=name)
+        elif fn is torch.sigmoid:
+            out = m.sigmoid(args[0], name=name)
+        elif fn is torch.tanh:
+            out = m.tanh(args[0], name=name)
+        elif fn in (torch.softmax, F.softmax):
+            axis = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            out = m.softmax(args[0], axis=axis, name=name)
+        elif fn is torch.flatten:
+            out = m.flat(args[0], name=name)
+        elif fn is torch.reshape:
+            out = m.reshape(args[0], args[1], name=name)
+        else:
+            raise NotImplementedError(
+                f"torch.fx import: unsupported function {fn} at {node.name}"
+            )
+        self.env[node.name] = out
+
+    def call_method(self, node):
+        m = self.model
+        args = [self.env[a.name] if hasattr(a, "name") and a.name in self.env
+                else a for a in node.args]
+        meth = node.target
+        if meth in ("view", "reshape"):
+            out = m.reshape(args[0], tuple(args[1:]), name=node.name)
+        elif meth == "flatten":
+            out = m.flat(args[0], name=node.name)
+        elif meth == "relu":
+            out = m.relu(args[0], name=node.name)
+        else:
+            raise NotImplementedError(
+                f"torch.fx import: unsupported method .{meth}() at {node.name}"
+            )
+        self.env[node.name] = out
+
+    def output(self, node):
+        arg = node.args[0]
+        if isinstance(arg, (tuple, list)):
+            self.env["__out__"] = [self.env[a.name] for a in arg]
+        else:
+            self.env["__out__"] = [self.env[arg.name]]
+
+
+def from_torch(
+    module,
+    input_shapes: Sequence,
+    mesh=None,
+    config: Optional[FFConfig] = None,
+    dtype="float32",
+) -> Tuple[FFModel, list, Dict[str, Dict[str, np.ndarray]]]:
+    """Trace ``module`` with torch.fx and rebuild it as an FFModel.
+
+    ``input_shapes``: one shape tuple per forward arg — or ``(shape, dtype)``
+    pairs for non-float inputs (e.g. ``((B,), "int32")`` for token ids).
+
+    Returns ``(model, outputs, weights)``: the un-compiled FFModel, its
+    output Tensors, and the imported torch weights keyed like
+    ``model.params`` — call ``model.compile(...)`` then
+    ``model.load_params(weights)``.
+    """
+    import torch.fx
+
+    gm = torch.fx.symbolic_trace(module)
+    model = FFModel(config or FFConfig(), mesh=mesh)
+    imp = _Importer(gm, model, input_shapes, dtype)
+    for node in gm.graph.nodes:
+        getattr(imp, node.op)(node)
+    return model, imp.env["__out__"], imp.weights

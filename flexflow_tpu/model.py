@@ -389,24 +389,33 @@ class FFModel:
             self.mesh = make_mesh(cfg.mesh_shape, cfg.devices())
         mesh = self.mesh
 
+        out_tids = [t.tid for t in outputs] if outputs else None
         if strategy is None and cfg.import_strategy_file:
             from .search.strategy import load_strategy
 
             strategy = load_strategy(cfg.import_strategy_file)
         if strategy is None and cfg.search_budget > 0 and not cfg.only_data_parallel:
+            # joint Unity search: graph rewrites (GraphXfer substitutions)
+            # explored in the same MCMC walk as parallel configs; the model
+            # adopts the rewritten graph (params are initialized after, so
+            # no weight migration is needed here)
             from .search.search import graph_optimize
 
-            strategy = graph_optimize(
-                self.graph, mesh, budget=cfg.search_budget, alpha=cfg.search_alpha
+            protected = out_tids or [self.graph.nodes[-1].outputs[-1]]
+            new_graph, strategy, tid_map = graph_optimize(
+                self.graph, mesh, budget=cfg.search_budget,
+                alpha=cfg.search_alpha, substitution=True,
+                output_tids=protected,
             )
+            self.graph = new_graph
+            if out_tids:
+                out_tids = [tid_map[t] for t in out_tids]
         if strategy is None:
             strategy = data_parallel_strategy(self.graph, mesh)
         if cfg.export_strategy_file:
             from .search.strategy import save_strategy
 
             save_strategy(cfg.export_strategy_file, strategy)
-
-        out_tids = [t.tid for t in outputs] if outputs else None
         self.pcg = PCG(self.graph, mesh, strategy, output_tids=out_tids)
         self.plan = self.pcg.plan()
         self._forward = build_forward(self.plan, mode=mode)
@@ -452,6 +461,45 @@ class FFModel:
         self.opt_state = self.optimizer.init_state(
             _filter(self.params, trainable_mask)
         )
+        if mesh is not None and mesh.size > 1:
+            # optimizer slots created from params inherit their shardings,
+            # but fresh scalars (Adam's step counter) land on one device —
+            # jit refuses mixed device sets, so replicate them on the mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+
+            def place(x):
+                if (hasattr(x, "sharding")
+                        and len(x.sharding.device_set) != mesh.size):
+                    return jax.device_put(x, rep)
+                return x
+
+            self.opt_state = jax.tree.map(place, self.opt_state)
+        return self
+
+    def load_params(self, weights) -> "FFModel":
+        """Merge imported weight arrays into ``self.params`` (post-compile).
+
+        ``weights``: ``{node_name: {param_name: array}}`` — the shape the
+        frontends (torch.fx import) and checkpoint restore produce.  Arrays
+        are cast to the existing param dtype and placed with its sharding.
+        """
+        if self.params is None:
+            raise RuntimeError("call compile() before load_params()")
+        for name, group in weights.items():
+            if name not in self.params:
+                raise KeyError(f"unknown param group {name!r}")
+            for p, v in group.items():
+                cur = self.params[name][p]
+                arr = jnp.asarray(v, cur.dtype)
+                if arr.shape != cur.shape:
+                    raise ValueError(
+                        f"{name}.{p}: shape {arr.shape} != {cur.shape}"
+                    )
+                if hasattr(cur, "sharding"):
+                    arr = jax.device_put(arr, cur.sharding)
+                self.params[name][p] = arr
         return self
 
     def _trainable_mask(self):
@@ -475,6 +523,12 @@ class FFModel:
             batch_size: Optional[int] = None, verbose: bool = True,
             shuffle: bool = True):
         assert self._train_step is not None, "call compile() first"
+        from .utils.profiling import maybe_profile
+
+        with maybe_profile(self.config.profiling):
+            return self._fit(x, y, epochs, batch_size, verbose, shuffle)
+
+    def _fit(self, x, y, epochs, batch_size, verbose, shuffle):
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
         inputs = self._standardize_inputs(x)
@@ -482,7 +536,14 @@ class FFModel:
         history = []
         for epoch in range(epochs):
             self._rng, ek = jax.random.split(self._rng)
-            idx = np.random.permutation(n) if shuffle else np.arange(n)
+            if shuffle:
+                # derive the permutation from the model's RNG stream (NOT
+                # the global numpy state) so training is reproducible and
+                # checkpoint/resume is bit-exact
+                seed = int(jax.random.randint(ek, (), 0, 2**31 - 1))
+                idx = np.random.RandomState(seed).permutation(n)
+            else:
+                idx = np.arange(n)
             losses, mets_acc = [], []
             t0 = time.perf_counter()
             for start in range(0, n - bs + 1, bs):

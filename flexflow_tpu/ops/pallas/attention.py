@@ -24,14 +24,15 @@ Design (v2 — measured on a real v5e chip):
 * online softmax in f32; optional ALiBi bias (slopes passed in) so MPT-style
   models ride the same kernel.
 
-Single-device only for now: under a >1 mesh the serve step runs in GSPMD
-global-array mode where a pallas_call would need a shard_map wrapper; the
-caller gates on mesh size.
+Under tensor parallelism the caller (serve/ops.py) wraps these kernels in a
+``shard_map`` over the kv-head axis — the cache's head dim is the shard dim,
+GQA groups stay intact per shard, so the kernel body is sharding-agnostic.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -144,9 +145,13 @@ def decode_attention(
            and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET):
         block_s //= 2
     block_s = min(block_s, s_len)
-    # non-dividing tails are fine: the grid rounds up and the causal mask
-    # (key_pos <= pos, with pos < s_len) discards the padded region
-    n_blocks = pl.cdiv(s_len, block_s)
+    # block_s must DIVIDE s_len: for a short tail block Pallas clamps the
+    # block start (dynamic-slice semantics), so the kernel would read keys
+    # shifted from where `base` says they are — the causal mask can't fix
+    # aliased positions.  gcd keeps a dividing power-of-two when possible.
+    if s_len % block_s:
+        block_s = math.gcd(block_s, s_len)
+    n_blocks = s_len // block_s
     qr = q.reshape(t, num_kv, gq, d)
     if slopes is None:
         slopes = jnp.zeros((qh,), jnp.float32)
@@ -199,3 +204,272 @@ def decode_attention(
     )(rows.astype(jnp.int32), positions.astype(jnp.int32),
       qr, k_cache, v_cache, slopes)
     return out.reshape(t, qh, d)
+
+
+def _tree_kernel(
+    rows_ref,       # scalar prefetch: i32[T] cache row per token
+    clens_ref,      # scalar prefetch: i32[T] committed cache depth per token
+    q_ref,          # [1, KV, gq, D] this token's queries
+    k_ref,          # [1, KV, Bs, D] committed-cache K block
+    v_ref,          # [1, KV, Bs, D]
+    sk_ref,         # [1, KV, P, D] spec-buffer K row (whole tree)
+    sv_ref,         # [1, KV, P, D]
+    bias_ref,       # [1, 1, P] f32 ancestor bias (0 = ancestor, NEG_INF = not)
+    o_ref,          # [1, KV, gq, D]
+    m_ref,          # VMEM scratch [KV, gq, 128]
+    l_ref,          # VMEM scratch [KV, gq, 128]
+    acc_ref,        # VMEM scratch [KV, gq, D]
+    *,
+    block_s: int,
+    num_kv: int,
+    gq: int,
+    scale: float,
+):
+    t = pl.program_id(0)
+    s = pl.program_id(1)
+    last_s = pl.num_programs(1) - 1
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    clen = clens_ref[t]
+    base = s * block_s
+
+    @pl.when(base < clen)  # blocks past the committed frontier: DMA clamped
+    def _committed():
+        q = q_ref[0].astype(jnp.float32)               # [KV, gq, D]
+        k = k_ref[0].astype(jnp.float32)               # [KV, Bs, D]
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [KV, gq, Bs]
+        key_pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv, gq, block_s), 2
+        )
+        live = key_pos < clen  # strict: committed prefix only
+        sc = jnp.where(live, sc, NEG_INF)
+
+        m_prev = m_ref[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(live, jnp.exp(sc - m_new), 0.0)
+        l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s == last_s)
+    def _spec_and_finalize():
+        q = q_ref[0].astype(jnp.float32)               # [KV, gq, D]
+        ks = sk_ref[0].astype(jnp.float32)             # [KV, P, D]
+        vs = sv_ref[0].astype(jnp.float32)
+        # bias arrives pre-padded to the 128-lane width ([1, G, Pp] with
+        # G == 1 or gq) and is kept >=2-D throughout: Mosaic gives 1-D
+        # values an implicit minor dim that poisons the downstream reduce
+        # ("unsupported output implicit dimension"); the K/V pad below
+        # matches it — padded slots carry NEG_INF bias so they vanish.
+        bias3 = bias_ref[...]                           # [1, G, Pp]
+        pad = bias3.shape[-1] - ks.shape[1]
+        if pad:
+            ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0)))
+            vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0)))
+        sc = jax.lax.dot_general(
+            q, ks, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [KV, gq, Pp]
+        live = jnp.broadcast_to(bias3 > NEG_INF / 2, sc.shape)
+        sc = sc + jnp.broadcast_to(bias3, sc.shape)
+
+        m_prev = m_ref[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(live, jnp.exp(sc - m_new), 0.0)
+        l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, vs, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s == last_s)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
+               scale, block_s, interpret):
+    """Shared pallas_call for the tree kernel.
+
+    ``qr``: [N, KV, G, D] query groups (N grid rows share one cache row);
+    ``bias``: [N, Gb, Pp] pre-padded ancestor bias with Gb in {1, G}.
+    """
+    n, num_kv, g, d = qr.shape
+    s_len = k_cache.shape[2]
+    p_len = k_spec.shape[2]
+    pp = bias.shape[-1]
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    while (block_s > 128
+           and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET):
+        block_s //= 2
+    block_s = min(block_s, s_len)
+    if s_len % block_s:  # see decode_attention: tail blocks alias positions
+        block_s = math.gcd(block_s, s_len)
+    n_blocks = s_len // block_s
+
+    def kv_map(i, j, rows, clens):
+        # clamp to the committed frontier so fully-masked blocks re-map to
+        # an already-fetched block (Pallas skips the copy)
+        limit = jnp.maximum(clens[i] - 1, 0) // block_s
+        return (rows[i], 0, jnp.minimum(j, limit), 0)
+
+    def spec_map(i, j, rows, clens):
+        return (rows[i], 0, 0, 0)
+
+    gb = bias.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, n_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, num_kv, g, d), lambda i, j, rows, clens: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_kv, p_len, d), spec_map, memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_kv, p_len, d), spec_map, memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, gb, pp), lambda i, j, rows, clens: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_kv, g, d), lambda i, j, rows, clens: (i, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, g, 128), jnp.float32),
+            pltpu.VMEM((num_kv, g, 128), jnp.float32),
+            pltpu.VMEM((num_kv, g, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _tree_kernel,
+        block_s=block_s, num_kv=num_kv, gq=g, scale=float(scale),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, num_kv, g, d), qr.dtype),
+        interpret=interpret,
+    )(rows.astype(jnp.int32), jnp.clip(clens, 0, s_len).astype(jnp.int32),
+      qr, k_cache, v_cache, k_spec, v_spec, bias)
+
+
+def _pad_bias(amask):
+    """bool[..., P] ancestor mask -> f32[..., Pp] additive bias, lane-padded."""
+    bias = jnp.where(amask, 0.0, NEG_INF).astype(jnp.float32)
+    pad = (-bias.shape[-1]) % 128
+    if pad:
+        widths = [(0, 0)] * (bias.ndim - 1) + [(0, pad)]
+        bias = jnp.pad(bias, widths, constant_values=NEG_INF)
+    return bias
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_s", "interpret")
+)
+def tree_attention(
+    q: jax.Array,        # [T, QH, D] (RoPE already applied)
+    k_cache: jax.Array,  # [R+1, KV, S, D] committed cache (post-commit)
+    v_cache: jax.Array,  # [R+1, KV, S, D]
+    k_spec: jax.Array,   # [R+1, KV, P, D] spec-tree buffer (current step's
+    v_spec: jax.Array,   # KV already written)
+    rows: jax.Array,     # i32[T] cache row per token
+    clens: jax.Array,    # i32[T] committed depth per token (strict < mask)
+    amask: jax.Array,    # bool[T, P] per-token tree-ancestor mask
+    scale: float,
+    block_s: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Two-segment tree-verify attention (SpecInfer's TreeIncMHA hot loop).
+
+    TPU-native replacement for the reference's
+    ``tree_inc_multihead_self_attention.cu``: each tree token attends its
+    request's committed cache (causal below ``clens[t]``) plus its root-path
+    ancestors in the spec buffer (``amask[t]``).  Reuses the decode kernel's
+    design: kv-head-major blocks, scalar-prefetched rows, causal DMA clamp
+    over the committed segment, online softmax carried across seq blocks;
+    the spec segment (small, one row) is folded in at the final grid step.
+    ALiBi models take the gather fallback (needs per-slot key positions).
+
+    One grid row per TOKEN: flexible for arbitrary flat batches, but tokens
+    of the same request re-stream the same cache; when the token layout is
+    a fixed ``[R, P]`` grid use :func:`tree_attention_batched`.
+    """
+    t, qh, d = q.shape
+    num_kv = k_cache.shape[1]
+    gq = qh // num_kv
+    qr = q.reshape(t, num_kv, gq, d)
+    bias = _pad_bias(amask)[:, None, :]  # [T, 1, Pp]
+    out = _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens,
+                     bias, scale, block_s, interpret)
+    return out.reshape(t, qh, d)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_s", "interpret")
+)
+def tree_attention_batched(
+    q: jax.Array,        # [R, P, QH, D] per-request tree-token queries
+    k_cache: jax.Array,  # [R+1, KV, S, D]
+    v_cache: jax.Array,  # [R+1, KV, S, D]
+    k_spec: jax.Array,   # [R+1, KV, Pb, D]
+    v_spec: jax.Array,
+    rows: jax.Array,     # i32[R] cache row per request
+    clens: jax.Array,    # i32[R] committed depth per request
+    amask: jax.Array,    # bool[R, P, Pb] per-request tree mask
+    scale: float,
+    block_s: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tree-verify attention for a FIXED [requests x tree-slots] layout.
+
+    The on-device speculative scan (serve/spec_scan.py) always ships exactly
+    P tree tokens per request, so all P tokens can share one grid row: the
+    committed-cache blocks stream ONCE per request instead of once per
+    token — a P-fold cut in the dominant HBM traffic (the committed mask is
+    per-request, so the fold into the query-group dim is exact).
+    """
+    r, p, qh, d = q.shape
+    num_kv = k_cache.shape[1]
+    gq = qh // num_kv
+    # [R, P, KV, gq, D] -> [R, KV, P*gq, D]: tree slots join the query-group
+    # dim; kv stays dim 1 (the cache layout / TP shard dim)
+    qr = q.reshape(r, p, num_kv, gq, d).transpose(0, 2, 1, 3, 4) \
+         .reshape(r, num_kv, p * gq, d)
+    # per-(slot, group) bias rows: [R, P, Pp] -> repeat gq -> [R, P*gq, Pp]
+    bias = jnp.repeat(_pad_bias(amask), gq, axis=1)
+    out = _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens,
+                     bias, scale, block_s, interpret)
+    return out.reshape(r, num_kv, p, gq, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(r, p, qh, d)

@@ -25,6 +25,7 @@ class TPUSpec:
     dcn_bandwidth: float        # bytes/s per host
     dcn_latency: float
     kernel_overhead: float = 2e-6   # per-op dispatch overhead inside a program
+    hbm_capacity: float = 16e9      # bytes per chip (memory-aware search)
 
 
 TPU_SPECS: Dict[str, TPUSpec] = {
@@ -38,6 +39,7 @@ TPU_SPECS: Dict[str, TPUSpec] = {
         ici_latency=1e-6,
         dcn_bandwidth=25e9,
         dcn_latency=10e-6,
+        hbm_capacity=16e9,
     ),
     "v5p": TPUSpec(
         name="v5p",
@@ -48,6 +50,7 @@ TPU_SPECS: Dict[str, TPUSpec] = {
         ici_latency=1e-6,
         dcn_bandwidth=25e9,
         dcn_latency=10e-6,
+        hbm_capacity=95e9,
     ),
     # virtual CPU mesh for hermetic tests: only relative costs matter
     "cpu": TPUSpec(
@@ -59,6 +62,7 @@ TPU_SPECS: Dict[str, TPUSpec] = {
         ici_latency=5e-6,
         dcn_bandwidth=1e9,
         dcn_latency=50e-6,
+        hbm_capacity=8e9,   # virtual-device test budget
     ),
 }
 

@@ -184,9 +184,17 @@ def measure_operator_cost(
 
 
 def calibrate_standard_probes(cache_path: Optional[str] = None) -> CostCache:
-    """Measure a spread of Linear/matmul/norm shapes to anchor the roofline."""
+    """Measure a spread of op shapes to anchor the roofline.
+
+    Covers the op families the search graphs actually contain (VERDICT r2
+    item 4): Linear (f32 + bf16), norms, training attention, softmax, and
+    embedding — not just f32 Linear.
+    """
+    from ..ops.attention import MultiHeadAttention
+    from ..ops.embedding import Embedding
     from ..ops.linear import Linear
     from ..ops.norm import LayerNorm, RMSNorm
+    from ..ops.reduction import Softmax
 
     cache = CostCache(cache_path)
     shapes = [
@@ -197,16 +205,35 @@ def calibrate_standard_probes(cache_path: Optional[str] = None) -> CostCache:
         (1024, 4096, 11008),
     ]
     for b, i, o in shapes:
-        op = Linear(o, use_bias=True, in_dim=i)
-        op.infer_shapes([TensorSpec((b, i))])
-        t = measure_operator_cost(op, [TensorSpec((b, i))], cache)
-        print(f"linear b={b} in={i} out={o}: {t * 1e6:.1f}us "
-              f"({2 * b * i * o / t / 1e12:.2f} TFLOP/s)")
+        for dt in ("float32", "bfloat16"):
+            op = Linear(o, use_bias=True, in_dim=i, dtype=dt)
+            spec = TensorSpec((b, i), jnp.dtype(dt))
+            op.infer_shapes([spec])
+            t = measure_operator_cost(op, [spec], cache)
+            print(f"linear[{dt}] b={b} in={i} out={o}: {t * 1e6:.1f}us "
+                  f"({2 * b * i * o / t / 1e12:.2f} TFLOP/s)")
     for b, d in [(64, 512), (256, 4096), (1024, 4096)]:
         for op in (LayerNorm(d), RMSNorm(d)):
             op.infer_shapes([TensorSpec((b, d))])
             t = measure_operator_cost(op, [TensorSpec((b, d))], cache)
             print(f"{op.type_name} b={b} d={d}: {t * 1e6:.1f}us")
+    for b, s, d, h in [(8, 64, 256, 8), (8, 256, 1024, 16), (1, 1024, 4096, 32)]:
+        op = MultiHeadAttention(d, h)
+        spec = TensorSpec((b, s, d))
+        op.infer_shapes([spec, spec, spec])
+        t = measure_operator_cost(op, [spec, spec, spec], cache)
+        print(f"attention b={b} s={s} d={d} h={h}: {t * 1e6:.1f}us")
+    for b, v in [(64, 512), (256, 16), (64, 32000)]:
+        op = Softmax()
+        op.infer_shapes([TensorSpec((b, v))])
+        t = measure_operator_cost(op, [TensorSpec((b, v))], cache)
+        print(f"softmax b={b} v={v}: {t * 1e6:.1f}us")
+    for b, v, d in [(64, 1024, 512), (512, 32000, 4096)]:
+        op = Embedding(v, d)
+        spec = TensorSpec((b,), jnp.int32)
+        op.infer_shapes([spec])
+        t = measure_operator_cost(op, [spec], cache)
+        print(f"embedding b={b} v={v} d={d}: {t * 1e6:.1f}us")
     cache.save()
     print(f"saved {len(cache.data)} measurements to {cache.path}")
     return cache

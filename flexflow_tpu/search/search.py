@@ -29,7 +29,7 @@ from ..core.graph import Graph
 from ..core.pcg import PCG
 from ..parallel.mesh import data_parallel_strategy
 from .machine_model import MachineModel
-from .simulator import simulate
+from .simulator import plan_memory_bytes, simulate
 
 Config = Dict[str, Tuple[str, ...]]
 
@@ -84,6 +84,7 @@ def graph_optimize(
     substitution: bool = False,
     output_tids: Optional[List[int]] = None,
     p_sub: float = 0.15,
+    memory_limit: Optional[float] = None,
 ):
     """Joint MCMC search over per-op parallel configs (+ graph rewrites).
 
@@ -104,9 +105,25 @@ def graph_optimize(
                 searchable.append(node.name)
         return searchable, candidates
 
-    def cost_of(g, strategy) -> float:
+    # memory-aware search (reference: memory_optimization.cc): plans whose
+    # per-device params+grads+opt-state+activations exceed HBM never become
+    # "best", but the walk may still traverse them under a cost penalty
+    # proportional to the overshoot — single-op moves from an infeasible
+    # state are usually infeasible too, so hard rejection would strand it.
+    # Default-on only for real accelerator specs: the 'cpu' spec backs
+    # virtual test meshes whose "devices" share host RAM, where the
+    # estimate's deliberate over-count would reject models that run fine.
+    mem_cap = memory_limit if memory_limit is not None \
+        else (mm.spec.hbm_capacity if mm.spec.name != "cpu" else 0)
+
+    def cost_of(g, strategy) -> Tuple[float, bool]:
         plan = PCG(g, mesh, strategy, output_tids=None).plan()
-        return simulate(plan, mm, training=training, measured=measured).total
+        t = simulate(plan, mm, training=training, measured=measured).total
+        if mem_cap:
+            need = plan_memory_bytes(plan, training=training)
+            if need > mem_cap:
+                return t * (2.0 + need / mem_cap), False
+        return t, True
 
     if substitution:
         from .substitution import apply_match, find_all_matches, standard_rules
@@ -120,12 +137,12 @@ def graph_optimize(
     state = dict(init if init is not None
                  else data_parallel_strategy(cur_graph, mesh))
     try:
-        cur_cost = cost_of(cur_graph, state)
+        cur_cost, cur_feas = cost_of(cur_graph, state)
     except (ValueError, AssertionError):
         state = {}
-        cur_cost = cost_of(cur_graph, state)
+        cur_cost, cur_feas = cost_of(cur_graph, state)
     best = (cur_graph, dict(state), dict(tid_map))
-    best_cost = cur_cost
+    best_cost = cur_cost if cur_feas else float("inf")
     if verbose:
         print(f"search: start cost {cur_cost * 1e3:.3f}ms, "
               f"{len(searchable)} searchable ops, budget {budget}")
@@ -170,7 +187,7 @@ def graph_optimize(
                 else:
                     prop_state[name] = cfg
             try:
-                new_cost = cost_of(res.graph, prop_state)
+                new_cost, new_feas = cost_of(res.graph, prop_state)
             except (ValueError, AssertionError):
                 continue
             if new_cost < cur_cost or rng.random() < math.exp(
@@ -182,7 +199,7 @@ def graph_optimize(
                 searchable, candidates = build_candidates(cur_graph)
                 cached_matches = None
                 accepted += 1
-                if cur_cost < best_cost:
+                if new_feas and cur_cost < best_cost:
                     best = (cur_graph, dict(state), dict(tid_map))
                     best_cost = cur_cost
                     if verbose:
@@ -203,7 +220,7 @@ def graph_optimize(
         else:
             proposal.pop(name, None)
         try:
-            new_cost = cost_of(cur_graph, proposal)
+            new_cost, new_feas = cost_of(cur_graph, proposal)
         except (ValueError, AssertionError):
             continue
         # Metropolis criterion (reference: FFModel::optimize MCMC)
@@ -212,7 +229,7 @@ def graph_optimize(
         ):
             state, cur_cost = proposal, new_cost
             accepted += 1
-            if cur_cost < best_cost:
+            if new_feas and cur_cost < best_cost:
                 best = (cur_graph, dict(state), dict(tid_map))
                 best_cost = cur_cost
                 if verbose:
@@ -222,6 +239,11 @@ def graph_optimize(
     if verbose:
         print(f"search: done, best {best_cost * 1e3:.3f}ms "
               f"({accepted}/{budget} accepted)")
+    if math.isinf(best_cost):
+        raise ValueError(
+            "graph_optimize found no strategy within the device memory "
+            f"limit ({mem_cap / 1e9:.2f}GB) in {budget} iterations"
+        )
     if substitution:
         return best
     return best[1]

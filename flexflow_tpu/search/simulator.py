@@ -94,6 +94,37 @@ def _measure_key(step: Step, mesh):
     return (step.node.op.attr_signature(), local_in)
 
 
+def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
+    """Per-device peak-HBM estimate for a planned PCG.
+
+    Reference: ``src/runtime/memory_optimization.cc`` (Unity's memory-aware
+    search).  Counts, per device: local param bytes (×4 when training:
+    weight + gradient + two optimizer slots — Adam's m and v; SGD momentum
+    uses one slot less, but the estimate must err HIGH), plus stored forward
+    activations (training keeps every op output for backward; inference only
+    the largest transient).  An upper bound, deliberately — the search uses
+    it to REJECT plans, so erring high only costs optimality, never an OOM.
+    """
+    mesh = plan.mesh
+    params = 0.0
+    acts = []
+    for step in plan.steps:
+        if step.is_parallel:
+            continue
+        pshs = plan.param_shardings.get(step.node.name, {})
+        for p in step.node.op.params():
+            sh = pshs.get(p.name)
+            n = _local_size(p.spec, sh, mesh) if sh is not None else p.spec.size
+            b = n * (p.spec.nbytes() // max(p.spec.size, 1))
+            params += b * (4.0 if training and p.trainable else 1.0)
+        for spec, sh in zip(step.out_specs, step.out_shardings):
+            acts.append(
+                _local_size(spec, sh, mesh) * (spec.nbytes() // max(spec.size, 1))
+            )
+    act = sum(acts) if training else max(acts, default=0)
+    return params + act
+
+
 def simulate(
     plan: Plan,
     machine: Optional[MachineModel] = None,

@@ -97,6 +97,9 @@ class InferenceManager:
             strategy = tensor_parallel_strategy(model.graph, self.tp_axes, mesh) \
                 if self.tp_axes else {}
         self.strategy = strategy
+        for node in model.graph.nodes:
+            if isinstance(node.op, IncMultiHeadSelfAttention):
+                node.op.cost_seq_len = max_seq_len
         if outputs is None:
             out_tids = [model.graph.nodes[-1].outputs[-1]]
         else:
@@ -108,30 +111,29 @@ class InferenceManager:
         self._token_tid = model.graph.input_tids[0]
         self.params = None
         self.state = None
-        # Pallas decode kernel: replaces the cache-row-gather attention on
-        # the incremental path.  "auto" = on for a single-device mesh on TPU
-        # (under TP the step runs in GSPMD global mode where pallas_call
-        # would need a shard_map wrapper — future work); True forces it on
+        # Pallas decode/tree kernels: replace the cache-row-gather attention.
+        # "auto" = on for TPU backends; under TP the attention op wraps the
+        # kernel in shard_map over the kv-head axis (IncMultiHeadSelfAttention
+        # ._head_shard_map) — shardings it can't express (non-head mesh axes
+        # > 1) fall back to the gather path per op.  True forces the flag on
         # (interpret mode off-TPU, for tests); False = pure-JAX path.
         # INIT-ONLY: the flags are baked into the jitted step at first trace;
         # mutating the attributes afterwards has no effect.
         backend = jax.default_backend()
-        trivial = mesh is None or mesh.size == 1
         if use_pallas == "auto":
-            self.use_pallas = trivial and backend == "tpu"
+            self.use_pallas = backend == "tpu"
         else:
-            if use_pallas and not trivial:
-                raise ValueError(
-                    "use_pallas=True requires a single-device mesh (the "
-                    "kernel is not yet wired through shard_map for TP)"
-                )
             self.use_pallas = bool(use_pallas)
         self.pallas_interpret = backend != "tpu"
+        # fixed tree-token layout (rows, slots) for tree-verify batches; set
+        # by SpecDecodeScan BEFORE the first tree step is traced (init-only,
+        # like use_pallas) — enables the batched tree kernel
+        self.tree_token_layout: Optional[Tuple[int, int]] = None
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
         self._scan = jax.jit(
             self._decode_scan_impl,
             donate_argnums=(1,),
-            static_argnames=("n_steps",),
+            static_argnames=("n_steps", "eos"),
         )
 
     # ------------------------------------------------------------------
@@ -168,6 +170,14 @@ class InferenceManager:
             )
             bufs = {}
             for name, (shape, dt, sh) in specs.items():
+                if name in ("k", "v"):
+                    # round the seq dim up to a lane-width multiple so the
+                    # Pallas kernels always get a dividing power-of-two
+                    # block (gcd fallback would otherwise collapse to tiny
+                    # blocks for odd max_seq_len); extra slots sit beyond
+                    # every mask
+                    s_pad = -(-shape[2] // 128) * 128
+                    shape = shape[:2] + (s_pad,) + shape[3:]
                 arr = jnp.zeros(shape, jnp.dtype(dt))
                 if mesh is not None and mesh.size > 1:
                     arr = jax.device_put(arr, sh.named_sharding(mesh))
@@ -176,7 +186,30 @@ class InferenceManager:
         return state
 
     # ------------------------------------------------------------------
-    def _step_impl(self, params, state, bc):
+    def _sample_tokens(self, logits, sample):
+        """Temperature + nucleus (top-p) sampling; exact argmax at T<=0.
+
+        Same math as the ``Sampling`` graph op (ops/reduction.py, reference
+        ``src/ops/sampling.cu``) but with DYNAMIC temperature/top_p (traced
+        scalars, so one compiled step serves every GenerationConfig) and an
+        explicit key threaded from the RequestManager.
+        """
+        key, temperature, top_p = sample
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def draw(_):
+            lg = logits / jnp.maximum(temperature, 1e-6)
+            sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_lg, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_lg, cutoff_idx, axis=-1)
+            lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+            return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+        return jax.lax.cond(temperature <= 0.0, lambda _: greedy, draw, None)
+
+    def _step_impl(self, params, state, bc, sample=None):
         base = bc if isinstance(bc, BatchConfig) else bc.base
         outs, new_state = self._fwd(
             params,
@@ -186,10 +219,15 @@ class InferenceManager:
                 "batch_config": bc,
                 "pallas_decode": self.use_pallas,
                 "pallas_interpret": self.pallas_interpret,
+                "tree_layout": self.tree_token_layout
+                if not isinstance(bc, BatchConfig) else None,
             },
         )
         logits = outs[0].astype(jnp.float32)  # [T, vocab]
-        token_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if sample is not None:
+            token_ids = self._sample_tokens(logits, sample)
+        else:
+            token_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits_max = jnp.max(logits, axis=-1)
         topk_ids = topk_lp = None
         if self.topk:
@@ -201,37 +239,67 @@ class InferenceManager:
             new_state,
         )
 
-    def step(self, bc) -> InferenceResult:
-        """Run one serving step; caches update in place (donated)."""
+    def step(self, bc, sample=None) -> InferenceResult:
+        """Run one serving step; caches update in place (donated).
+
+        ``sample``: optional ``(key, temperature, top_p)`` — argmax if None.
+        """
         assert self.params is not None, "call init_operators_inference() first"
-        result, self.state = self._step(self.params, self.state, bc)
+        result, self.state = self._step(self.params, self.state, bc, sample)
         return result
 
     # ------------------------------------------------------------------
-    def _decode_scan_impl(self, params, state, bc, n_steps: int):
+    def _decode_scan_impl(self, params, state, bc, sample, n_steps: int,
+                          eos: Optional[int]):
         """n_steps pure-decode steps as ONE on-device ``lax.scan``.
 
         TPU-first redesign of the reference's serving loop (§3.3): instead of
         a host round trip per token (``prepare_next_batch`` → dispatch →
         sync), the next step's BatchConfig is derived on device from the
-        step's argmax (``BatchConfig.advance``) and the host only syncs once
+        step's output (``BatchConfig.advance``) and the host only syncs once
         per scan.  With dispatch latency L and device step time t, TPOT drops
         from ``max(L, t)`` to ``t + L/n_steps``.
+
+        ``eos`` (static): slots that emit it are FROZEN for the rest of the
+        scan — their request_index flips to -1, so later steps write their
+        KV to the scratch row and their emissions are masked out of ``live``.
         """
-        def body(carry, _):
-            state, bc = carry
-            result, state = self._step_impl(params, state, bc)
-            return (state, bc.advance(result.token_ids)), result.token_ids
+        def body(carry, i):
+            state, bc, alive = carry
+            stp = None
+            if sample is not None:
+                key, temperature, top_p = sample
+                stp = (jax.random.fold_in(key, i), temperature, top_p)
+            result, state = self._step_impl(params, state, bc, stp)
+            toks = result.token_ids
+            live = alive  # emission validity for THIS step
+            if eos is not None:
+                alive = alive & (toks != eos)
+            nxt = bc.advance(toks)
+            if eos is not None:
+                nxt = BatchConfig(
+                    tokens=nxt.tokens,
+                    request_index=jnp.where(alive, nxt.request_index, -1),
+                    token_position=nxt.token_position,
+                    num_tokens=nxt.num_tokens,
+                    seq_lens=nxt.seq_lens,
+                )
+            return (state, nxt, alive), (toks, live)
 
-        (state, bc), tokens = jax.lax.scan(
-            body, (state, bc), None, length=n_steps
+        alive0 = bc.request_index >= 0
+        (state, bc, _), (tokens, live) = jax.lax.scan(
+            body, (state, bc, alive0), jnp.arange(n_steps)
         )
-        return tokens, state, bc
+        return tokens, live, state, bc
 
-    def decode_scan(self, bc, n_steps: int):
-        """Run ``n_steps`` decode steps on device; returns i32[n_steps, T]
-        token ids (position p's output for each flat slot) and the advanced
-        BatchConfig for the host to resume from."""
+    def decode_scan(self, bc, n_steps: int, eos: Optional[int] = None,
+                    sample=None):
+        """Run ``n_steps`` decode steps on device.
+
+        Returns ``(tokens, live, bc)``: i32[n_steps, T] token ids,
+        bool[n_steps, T] emission validity (False once a slot passed its
+        ``eos``), and the advanced BatchConfig to resume from.
+        """
         assert self.params is not None, "call init_operators_inference() first"
         import numpy as np
 
@@ -242,10 +310,10 @@ class InferenceManager:
                 f"{self.max_seq_len}; cache writes past the end clamp to the "
                 "last slot and silently corrupt it"
             )
-        tokens, self.state, bc = self._scan(
-            self.params, self.state, bc, n_steps=n_steps
+        tokens, live, self.state, bc = self._scan(
+            self.params, self.state, bc, sample, n_steps=n_steps, eos=eos
         )
-        return tokens, bc
+        return tokens, live, bc
 
     def reset(self):
         """Clear all cache contents (new serving session)."""

@@ -218,9 +218,9 @@ class IncMultiHeadSelfAttention(Op):
 
         if isinstance(bc, TreeVerifyBatchConfig):
             state = self._commit(state, bc)
-            out, state = self._tree_attend(q, k, v, state, bc)
+            out, state = self._tree_attend(q, k, v, state, bc, ctx)
         elif isinstance(bc, TreeSearchBatchConfig):
-            out, state = self._tree_attend(q, k, v, state, bc)
+            out, state = self._tree_attend(q, k, v, state, bc, ctx)
         else:
             out, state = self._inc_attend(q, k, v, state, bc, ctx)
 
@@ -322,6 +322,41 @@ class IncMultiHeadSelfAttention(Op):
             mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
         )
 
+    @staticmethod
+    def _head_shard_map(ctx, head_axes, in_specs, out_specs):
+        """shard_map wrapper for a Pallas attention call under GSPMD.
+
+        Returns the identity when the mesh is trivial (plain single-device
+        call), a ``shard_map`` partial over the kv-head axis when every
+        non-trivial mesh axis is a head axis (Megatron serve TP: GQA groups
+        stay intact per shard, so the kernel runs unchanged on local
+        shapes), and ``None`` when the sharding is unsupported — the caller
+        falls back to the gather path.
+        """
+        mesh = ctx.mesh if ctx is not None else None
+        if mesh is None or all(mesh.shape[a] == 1 for a in mesh.axis_names):
+            return lambda f: f
+        nontrivial = {a for a in mesh.axis_names if mesh.shape[a] > 1}
+        if not head_axes or not nontrivial.issubset(set(head_axes)):
+            return None
+        try:
+            from jax import shard_map
+            kw = {"check_vma": False}  # jax >= 0.8 spelling
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+            kw = {"check_rep": False}
+
+        def wrap(f):
+            return shard_map(
+                f, mesh=mesh, in_specs=tuple(in_specs),
+                out_specs=out_specs, **kw,
+            )
+
+        return wrap
+
+    def _config_head_axes(self, ctx):
+        return tuple(ctx.config.get("head", ())) if ctx and ctx.config else ()
+
     def _inc_attend(self, q, k, v, state, bc: BatchConfig, ctx=None):
         kc, vc = state["k"], state["v"]  # [R+1, KV, S, D]
         nreq = kc.shape[0] - 1
@@ -330,21 +365,38 @@ class IncMultiHeadSelfAttention(Op):
         kc = self._scatter_rows_pos(kc, rows, pos, k)
         vc = self._scatter_rows_pos(vc, rows, pos, v)
         if ctx is not None and ctx.extras.get("pallas_decode"):
+            from jax.sharding import PartitionSpec as P
+
             from ..ops.pallas.attention import decode_attention
 
             t = q.shape[0]
-            out = decode_attention(
-                q.reshape(t, self.num_q_heads, self.head_dim),
-                kc, vc, rows, pos,
-                scale=self.scaling_factor,
-                slopes=alibi_slopes(self.num_q_heads)
-                if self.use_alibi else None,
-                use_alibi=self.use_alibi,
-                interpret=bool(ctx.extras.get("pallas_interpret")),
+            interp = bool(ctx.extras.get("pallas_interpret"))
+            slopes = alibi_slopes(self.num_q_heads).reshape(
+                self.num_kv_heads, self.q_per_kv
+            )  # [KV, gq]: shardable over the kv-head dim
+
+            def attend(q_, kc_, vc_, rows_, pos_, slopes_):
+                kv_l, gq = q_.shape[1], q_.shape[2]
+                return decode_attention(
+                    q_.reshape(t, kv_l * gq, self.head_dim),
+                    kc_, vc_, rows_, pos_,
+                    scale=self.scaling_factor,
+                    slopes=slopes_.reshape(-1) if self.use_alibi else None,
+                    use_alibi=self.use_alibi, interpret=interp,
+                ).reshape(t, kv_l, gq, self.head_dim)
+
+            h = self._config_head_axes(ctx)
+            sm = self._head_shard_map(
+                ctx, h,
+                [P(None, h), P(None, h), P(None, h), P(), P(), P(h)],
+                P(None, h),
             )
-            new_state = dict(state)
-            new_state["k"], new_state["v"] = kc, vc
-            return out, new_state
+            if sm is not None:
+                out = sm(attend)(q, kc, vc, rows, pos, slopes)
+                out = out.reshape(t, self.num_q_heads, self.head_dim)
+                new_state = dict(state)
+                new_state["k"], new_state["v"] = kc, vc
+                return out, new_state
         # fallback: gather each token's cache row: [T, KV, S, D]
         k_tok = kc[rows]
         v_tok = vc[rows]
@@ -397,7 +449,7 @@ class IncMultiHeadSelfAttention(Op):
         new_state["k"], new_state["v"] = kc, vc
         return new_state
 
-    def _tree_attend(self, q, k, v, state, bc):
+    def _tree_attend(self, q, k, v, state, bc, ctx=None):
         """Attend over committed cache (causal) + spec-tree buffer (ancestor mask).
 
         Used by both the draft model's expansion steps (SpecInc) and the
@@ -416,6 +468,60 @@ class IncMultiHeadSelfAttention(Op):
             spec_pos = state["spec_pos"].at[rows, spec_idx].set(
                 base.token_position
             )
+        if (ctx is not None and ctx.extras.get("pallas_decode")
+                and not self.use_alibi):
+            from jax.sharding import PartitionSpec as P
+
+            from ..ops.pallas.attention import (
+                tree_attention,
+                tree_attention_batched,
+            )
+
+            t = q.shape[0]
+            interp = bool(ctx.extras.get("pallas_interpret"))
+            clens = bc.committed_lens[rows]     # scratch row clamps to last
+            amask = bc.ancestor_mask[rows, spec_idx]
+            # fixed [R, P] token layout (the on-device spec scan): all P
+            # tree tokens of a request share one kernel grid row, so the
+            # committed cache streams once per REQUEST, not once per token
+            layout = ctx.extras.get("tree_layout")
+
+            def attend(q_, kc_, vc_, sk_, sv_, rows_, clens_, amask_):
+                kv_l, gq = q_.shape[1], q_.shape[2]
+                d = self.head_dim
+                if layout:
+                    r_t, p_t = layout
+                    used = r_t * p_t
+                    qf = q_.reshape(t, kv_l * gq, d)
+                    ob = tree_attention_batched(
+                        qf[:used].reshape(r_t, p_t, kv_l * gq, d),
+                        kc_, vc_, sk_, sv_,
+                        rows_[:used:p_t], clens_[:used:p_t],
+                        amask_[:used].reshape(r_t, p_t, -1),
+                        scale=self.scaling_factor, interpret=interp,
+                    ).reshape(used, kv_l * gq, d)
+                    if used < t:  # capacity-pad tokens: outputs are ignored
+                        ob = jnp.zeros((t, kv_l * gq, d), ob.dtype) \
+                            .at[:used].set(ob)
+                    return ob.reshape(t, kv_l, gq, d)
+                return tree_attention(
+                    q_.reshape(t, kv_l * gq, d),
+                    kc_, vc_, sk_, sv_, rows_, clens_, amask_,
+                    scale=self.scaling_factor, interpret=interp,
+                ).reshape(t, kv_l, gq, d)
+
+            h = self._config_head_axes(ctx)
+            sm = self._head_shard_map(
+                ctx, h,
+                [P(None, h)] * 5 + [P(), P(), P()],
+                P(None, h),
+            )
+            if sm is not None:
+                out = sm(attend)(q, kc, vc, sk, sv, rows, clens, amask)
+                out = out.reshape(t, self.num_q_heads, self.head_dim)
+                new_state = dict(state)
+                new_state["sk"], new_state["sv"] = sk, sv
+                return out, new_state
 
         k_cache_tok = kc[rows]   # [T, KV, S, D]
         v_cache_tok = vc[rows]
@@ -479,13 +585,16 @@ class IncMultiHeadSelfAttention(Op):
             out_sh = out_sh.with_partial(head)
         return ShardingSolution(inputs=[x_sh], outputs=[out_sh], params=params)
 
+    # cache depth used for costing; the InferenceManager sets this to its
+    # max_seq_len at compile so the simulator sees the deployment's actual
+    # attention span instead of a hard-coded constant (VERDICT r2 item 4)
+    cost_seq_len: Optional[int] = None
+
     def flops(self, in_specs):
         t = in_specs[0].shape[0]
         e = self.embed_dim
         qh, d = self.num_q_heads, self.head_dim
-        # projections + attention (attention cost depends on cache depth; use
-        # a nominal 1k context for costing)
-        s = 1024
+        s = self.cost_seq_len or 1024
         proj = 2 * t * e * (qh + 2 * self.num_kv_heads) * d + 2 * t * qh * d * e
         attn = 2 * t * qh * d * s * 2
         return proj + attn

@@ -50,6 +50,13 @@ class GenerationConfig:
     max_new_tokens: int = 64
     eos_token_id: Optional[int] = None
     stop_on_eos: bool = True
+    # sampling (reference: GenerationConfig in flexflow/inference.py + the
+    # Sampling op).  temperature <= 0 -> exact greedy argmax.  Sampling is
+    # incremental-decoding only; speculative serving stays greedy (the
+    # accept walk's equality test requires deterministic targets).
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
 
 
 class RequestManager:
@@ -64,6 +71,22 @@ class RequestManager:
         self._next_rid = 0
         self.steps = 0
         self.tokens_decoded = 0
+        self.scan_runs = 0      # decode stretches run as on-device scans
+        self._sample_calls = 0  # folds the per-call key for seeded sampling
+
+    def _sample_arg(self):
+        """(key, temperature, top_p) for the step, or None for greedy."""
+        if self.gen.temperature <= 0.0:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        self._sample_calls += 1
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.gen.seed), self._sample_calls
+        )
+        return (key, jnp.float32(self.gen.temperature),
+                jnp.float32(self.gen.top_p))
 
     # ------------------------------------------------------------------
     def _seq_len_needed(self, req: Request) -> int:
@@ -190,14 +213,79 @@ class RequestManager:
                 req.slot = -1
 
     # ------------------------------------------------------------------
+    def _scan_steps_possible(self) -> int:
+        """How many pure-decode steps can run as ONE on-device scan now.
+
+        > 1 only when no admission/prefill work is pending and every active
+        request is decoding; bounded by the smallest remaining token budget
+        (so no slot overshoots max_new_tokens) and by cache headroom.
+        """
+        active = self._active()
+        if (self.pending or not active
+                or any(r.status is not RequestStatus.DECODING
+                       for r in active)):
+            return 0
+        n = min(r.max_new_tokens - len(r.generated) for r in active)
+        n = min(n, self.scan_chunk,
+                self.im.max_seq_len - max(r.seq_len for r in active) + 1)
+        # round down to a power of two: n is a STATIC arg of the jitted
+        # scan, so every distinct value compiles the whole n-step model —
+        # quantizing bounds the compile count to ~log2(scan_chunk) variants
+        if n > 1:
+            n = 1 << (n.bit_length() - 1)
+        return n
+
+    scan_chunk = 32  # sync-amortization window for the decode scan
+
+    def _decode_stretch(self, n: int) -> None:
+        """Run n decode steps on device with one host sync (decode_scan)."""
+        active = self._active()
+        tokens, reqi, pos = [], [], []
+        points = []
+        for req in active:
+            tokens.append(req.generated[-1])
+            reqi.append(req.slot)
+            pos.append(req.seq_len - 1)
+            points.append(req.rid)
+        seq_lens = np.zeros(self.im.max_requests, np.int32)
+        for req in active:
+            seq_lens[req.slot] = req.seq_len
+        bc = BatchConfig.build(
+            tokens, reqi, pos, seq_lens,
+            max_tokens=self.im.max_tokens, max_requests=self.im.max_requests,
+        )
+        eos = self.gen.eos_token_id if self.gen.stop_on_eos else None
+        toks, live, _ = self.im.decode_scan(
+            bc, n, eos=eos, sample=self._sample_arg()
+        )
+        toks = np.asarray(toks)
+        live = np.asarray(live)
+        for s in range(n):
+            for flat, rid in enumerate(points):
+                req = self.requests[rid]
+                if req.status is not RequestStatus.DECODING or not live[s, flat]:
+                    continue
+                req.generated.append(int(toks[s, flat]))
+                self.tokens_decoded += 1
+                self._maybe_finish(req)
+        self.steps += n
+        self.scan_runs += 1
+
     def serve_incr_decoding(self) -> Dict[int, List[int]]:
         """Run the incremental-decoding loop until all requests complete.
 
-        Reference: ``RequestManager::serve_incr_decoding``.
+        Reference: ``RequestManager::serve_incr_decoding`` — but the pure-
+        decode stretches run as ONE on-device ``lax.scan`` (EOS-masked), so
+        the ~100ms tunnel sync amortizes over up to ``scan_chunk`` tokens;
+        the per-step host path only handles admission/prefill boundaries.
         """
         while self.has_work():
+            n = self._scan_steps_possible()
+            if n > 1:
+                self._decode_stretch(n)
+                continue
             bc, sample_points = self.prepare_next_batch()
-            result = self.im.step(bc)
+            result = self.im.step(bc, sample=self._sample_arg())
             self.process_result(result, sample_points)
             self.steps += 1
         return {rid: r.generated for rid, r in self.requests.items()}
@@ -212,5 +300,9 @@ class RequestManager:
         rids = [
             self.register_new_request(p, max_new_tokens) for p in prompts
         ]
-        out = self._serve()
+        from ..utils.profiling import maybe_profile
+
+        profiling = bool(getattr(self.im.model.config, "profiling", False))
+        with maybe_profile(profiling):
+            out = self._serve()
         return [out[rid] for rid in rids]

@@ -1,0 +1,101 @@
+"""Training checkpoint/resume: sharded params + optimizer state + RNG.
+
+Reference: the reference's checkpointing story (SURVEY.md §5) — FlexFlow
+saves/restores model weights and optimizer slots so training resumes
+bit-exactly.  TPU-native shape: arrays are gathered host-side with their
+pytree key paths as names (``.npz``, no pickle), and restore places each
+leaf back with the live array's sharding — so a checkpoint written from one
+mesh layout restores onto any layout of the same model.
+
+Layout on disk (a directory):
+  params.npz     flattened {keypath: array}
+  opt_state.npz  flattened optimizer pytree (momentum/Adam slots)
+  rng.npy        the model's PRNG key
+  meta.json      step counter + format version
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.tree_util import keystr, tree_flatten_with_path, tree_map_with_path
+
+_FORMAT = 1
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    leaves = tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in leaves:
+        if leaf is None:
+            continue
+        out[keystr(path)] = np.asarray(leaf)
+    return out
+
+
+def _restore_into(tree, arrays: Dict[str, np.ndarray]):
+    """Rebuild ``tree`` with saved leaves, keeping each live leaf's dtype
+    and sharding (the checkpoint is mesh-layout agnostic)."""
+
+    def leaf(path, cur):
+        if cur is None:
+            return None
+        key = keystr(path)
+        if key not in arrays:
+            raise KeyError(f"checkpoint missing leaf {key}")
+        arr = jax.numpy.asarray(arrays[key], cur.dtype)
+        if arr.shape != cur.shape:
+            raise ValueError(
+                f"{key}: checkpoint shape {arr.shape} != model {cur.shape}"
+            )
+        if hasattr(cur, "sharding"):
+            arr = jax.device_put(arr, cur.sharding)
+        return arr
+
+    return tree_map_with_path(leaf, tree)
+
+
+def save_checkpoint(path: str, model, step: Optional[int] = None) -> None:
+    """Write ``model``'s params, optimizer state, and RNG under ``path``."""
+    if model.params is None:
+        raise RuntimeError("compile() the model before checkpointing")
+    os.makedirs(path, exist_ok=True)
+
+    def dump(fname, tree):
+        arrays = _flatten(tree)
+        tmp = os.path.join(path, fname + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(path, fname))
+
+    dump("params.npz", model.params)
+    dump("opt_state.npz", model.opt_state)
+    np.save(os.path.join(path, "rng.npy"), np.asarray(model._rng))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"format": _FORMAT, "step": step}, f)
+
+
+def restore_checkpoint(path: str, model) -> Optional[int]:
+    """Restore a checkpoint written by :func:`save_checkpoint` into a
+    compiled model of the same architecture; returns the saved step."""
+    if model.params is None:
+        raise RuntimeError("compile() the model before restoring")
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format") != _FORMAT:
+        raise ValueError(f"unknown checkpoint format {meta.get('format')}")
+
+    def load(fname):
+        with np.load(os.path.join(path, fname)) as z:
+            return {k: z[k] for k in z.files}
+
+    model.params = _restore_into(model.params, load("params.npz"))
+    model.opt_state = _restore_into(model.opt_state, load("opt_state.npz"))
+    model._rng = jax.numpy.asarray(
+        np.load(os.path.join(path, "rng.npy")), model._rng.dtype
+    )
+    return meta.get("step")

@@ -1,0 +1,31 @@
+"""Profiling hook: ``FFConfig.profiling`` -> jax.profiler trace artifacts.
+
+Reference: the reference's ``--profiling`` flag + Legion's runtime tracing
+(SURVEY.md §5).  The TPU-native equivalent is an XLA/TPU trace captured with
+``jax.profiler`` (viewable in XProf/TensorBoard or Perfetto); training and
+serving entry points wrap their loops in :func:`maybe_profile`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+TRACE_DIR = os.path.join("artifacts", "profile")
+
+
+@contextlib.contextmanager
+def maybe_profile(enabled: bool, trace_dir: str = None):
+    """Capture a jax.profiler trace around the body when ``enabled``."""
+    if not enabled:
+        yield None
+        return
+    import jax
+
+    trace_dir = trace_dir or TRACE_DIR
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield trace_dir
+    finally:
+        jax.profiler.stop_trace()

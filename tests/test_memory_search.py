@@ -1,0 +1,73 @@
+"""Memory-aware strategy search (reference: memory_optimization.cc).
+
+The hard gate: when the time-optimal strategy does not fit per-device HBM,
+``graph_optimize`` must return the feasible next-best instead of an
+un-runnable plan.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, make_mesh
+from flexflow_tpu.core.pcg import PCG
+from flexflow_tpu.search.machine_model import MachineModel
+from flexflow_tpu.search.search import graph_optimize
+from flexflow_tpu.search.simulator import plan_memory_bytes
+from flexflow_tpu.parallel.mesh import data_parallel_strategy
+
+
+def big_mlp(mesh, batch=64, width=2048):
+    model = FFModel(FFConfig(batch_size=batch), mesh=mesh)
+    x = model.create_tensor((batch, width))
+    h = model.dense(x, width, activation="relu")
+    h = model.dense(h, width, activation="relu")
+    model.softmax(model.dense(h, 16))
+    return model
+
+
+def test_plan_memory_counts_sharded_params():
+    mesh = make_mesh({"dp": 2, "tp": 2}, jax.devices()[:4])
+    model = big_mlp(mesh)
+    g = model.graph
+    dp = data_parallel_strategy(g, mesh)
+    mem_dp = plan_memory_bytes(PCG(g, mesh, dp).plan(), training=True)
+    # channel-sharded params use less per-device memory than replicated
+    tp = dict(dp)
+    for node in g.nodes:
+        if node.op.type_name == "linear" and node.op.out_dim % 2 == 0:
+            tp[node.name] = {**tp.get(node.name, {}), "channel_out": ("tp",)}
+    mem_tp = plan_memory_bytes(PCG(g, mesh, tp).plan(), training=True)
+    assert mem_tp < mem_dp
+
+
+def test_search_rejects_infeasible_best():
+    mesh = make_mesh({"dp": 2, "tp": 2}, jax.devices()[:4])
+    model = big_mlp(mesh)
+    g = model.graph
+    mm = MachineModel.for_mesh(mesh, spec_name="v5e")
+    dp = data_parallel_strategy(g, mesh)
+
+    free = graph_optimize(g, mesh, budget=150, machine=mm, seed=0, init=dp,
+                          memory_limit=0)  # 0 disables the memory guard
+    mem_free = plan_memory_bytes(PCG(g, mesh, free).plan(), training=True)
+
+    # a limit below the unconstrained winner's footprint but above the
+    # fully-sharded floor: search must route around the infeasible optimum
+    limit = mem_free * 0.6
+    capped = graph_optimize(g, mesh, budget=300, machine=mm, seed=0, init=dp,
+                            memory_limit=limit)
+    mem_capped = plan_memory_bytes(PCG(g, mesh, capped).plan(), training=True)
+    assert mem_capped <= limit, (
+        f"search returned an infeasible plan: {mem_capped} > {limit}"
+    )
+    assert capped != free
+
+
+def test_search_raises_when_nothing_fits():
+    mesh = make_mesh({"dp": 2}, jax.devices()[:2])
+    model = big_mlp(mesh)
+    mm = MachineModel.for_mesh(mesh, spec_name="v5e")
+    with pytest.raises(ValueError, match="memory"):
+        graph_optimize(model.graph, mesh, budget=30, machine=mm, seed=0,
+                       memory_limit=1024)  # 1KB: nothing fits

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from flexflow_tpu.ops.pallas.attention import decode_attention
+from flexflow_tpu.ops.pallas.attention import decode_attention, tree_attention
 from flexflow_tpu.serve import GenerationConfig, RequestManager
 from flexflow_tpu.serve.ops import alibi_slopes
 
@@ -71,6 +71,151 @@ def test_kernel_alibi_matches_reference():
     want = ref_attention(q, kc, vc, rows, pos, 0.35, slopes=slopes)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
+
+
+def ref_tree_attention(q, kc, vc, sk, sv, rows, clens, amask, scale):
+    """Gather-based two-segment formulation (serve/ops.py's fallback)."""
+    k_tok, v_tok = kc[rows], vc[rows]      # [T, KV, S, D]
+    ks_tok, vs_tok = sk[rows], sv[rows]    # [T, KV, P, D]
+    t, kv, s, d = k_tok.shape
+    qh = q.shape[1]
+    gq = qh // kv
+    qr = q.reshape(t, kv, gq, d)
+    sc_c = jnp.einsum("tkgd,tksd->tkgs", qr, k_tok).astype(jnp.float32) * scale
+    sc_p = jnp.einsum("tkgd,tkpd->tkgp", qr, ks_tok).astype(jnp.float32) * scale
+    cmask = jnp.arange(s)[None, :] < clens[:, None]
+    sc_c = jnp.where(cmask[:, None, None, :], sc_c, -1e30)
+    sc_p = jnp.where(amask[:, None, None, :], sc_p, -1e30)
+    w = jax.nn.softmax(jnp.concatenate([sc_c, sc_p], -1), axis=-1)
+    v_all = jnp.concatenate([v_tok, vs_tok], axis=2).astype(w.dtype)
+    out = jnp.einsum("tkgs,tksd->tkgd", w, v_all)
+    return out.reshape(t, qh, d)
+
+
+@pytest.mark.parametrize("qh,kv,d,s,p,block", [
+    (4, 2, 8, 32, 8, 16),    # GQA
+    (4, 4, 8, 32, 8, 32),    # MHA, single block
+    (8, 1, 16, 64, 16, 16),  # MQA, deeper tree buffer
+    (4, 2, 8, 40, 8, 16),    # non-dividing seq len -> padded tail block
+])
+def test_tree_kernel_matches_reference(qh, kv, d, s, p, block):
+    rng = np.random.default_rng(2)
+    t, r = 7, 3
+    q = jnp.asarray(rng.normal(size=(t, qh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
+    sk = jnp.asarray(rng.normal(size=(r + 1, kv, p, d)), jnp.float32)
+    sv = jnp.asarray(rng.normal(size=(r + 1, kv, p, d)), jnp.float32)
+    rows = jnp.asarray([0, 0, 1, 2, 1, 0, 3], jnp.int32)  # 3 = scratch row
+    # mix: mid-cache, empty committed cache (pure tree), full cache
+    clens = jnp.asarray([5, 5, 0, s, 0, 17, 0], jnp.int32)
+    # random root-path-style masks incl. always-self plus a few ancestors
+    amask = rng.random((t, p)) < 0.4
+    amask[:, 0] = True
+    amask = jnp.asarray(amask)
+    scale = 1.0 / np.sqrt(d)
+    got = tree_attention(q, kc, vc, sk, sv, rows, clens, amask, scale,
+                         block_s=block, interpret=True)
+    want = ref_tree_attention(q, kc, vc, sk, sv, rows, clens, amask, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_e2e_spec_infer_with_pallas_kernel():
+    # whole SpecInfer stack with the tree kernel on (interpret mode on CPU):
+    # outputs must match plain incremental decoding exactly, and the LLM's
+    # verify steps must actually take the Pallas path (use_pallas=True).
+    from flexflow_tpu.serve import ServeModelConfig, SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    im = make_im(max_tokens=32, max_requests=2, max_seq=64)
+    want = RequestManager(im, GenerationConfig(max_new_tokens=10)).generate(prompts)
+
+    tiny_ssm = ServeModelConfig(
+        model_type="llama", vocab_size=TINY.vocab_size, hidden_size=16,
+        intermediate_size=32, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2,
+    )
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  use_pallas=True)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=tiny_ssm, topk=2, seed=123, use_pallas=True)
+    assert llm.use_pallas and ssm.use_pallas
+    sm = SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=10), width=2, depth=2
+    )
+    got = sm.generate(prompts)
+    assert got == want
+
+
+@pytest.mark.parametrize("qh,kv,d,s,p,pb", [
+    (4, 2, 8, 32, 4, 8),    # GQA, tree smaller than buffer
+    (8, 1, 16, 64, 3, 8),   # MQA, odd tree size
+])
+def test_batched_tree_kernel_matches_flat(qh, kv, d, s, p, pb):
+    from flexflow_tpu.ops.pallas.attention import tree_attention_batched
+
+    rng = np.random.default_rng(5)
+    r = 3
+    q = jnp.asarray(rng.normal(size=(r, p, qh, d)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(r + 1, kv, s, d)), jnp.float32)
+    sk = jnp.asarray(rng.normal(size=(r + 1, kv, pb, d)), jnp.float32)
+    sv = jnp.asarray(rng.normal(size=(r + 1, kv, pb, d)), jnp.float32)
+    rows = jnp.asarray([0, 2, 3], jnp.int32)       # incl. scratch row
+    clens = jnp.asarray([7, 0, s], jnp.int32)
+    amask = rng.random((r, p, pb)) < 0.4
+    amask[:, :, 0] = True
+    amask = jnp.asarray(amask)
+    scale = 1.0 / np.sqrt(d)
+    got = tree_attention_batched(q, kc, vc, sk, sv, rows, clens, amask,
+                                 scale, block_s=16, interpret=True)
+    # flat reference: expand to per-token arrays
+    rows_t = jnp.repeat(rows, p)
+    clens_t = jnp.repeat(clens, p)
+    want = ref_tree_attention(
+        q.reshape(r * p, qh, d), kc, vc, sk, sv, rows_t, clens_t,
+        amask.reshape(r * p, pb), scale,
+    ).reshape(r, p, qh, d)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_tp_serving_with_pallas_kernel():
+    # tensor-parallel serving with the kernels wrapped in shard_map over the
+    # kv-head axis: tokens must match the single-device pure-JAX golden.
+    im1 = make_im({"tp": 1})
+    im2 = make_im({"tp": 2}, use_pallas=True)
+    assert im2.use_pallas
+    prompt = [3, 11, 25, 40, 7]
+    out1 = RequestManager(im1, GenerationConfig(max_new_tokens=8)).generate(
+        [prompt])[0]
+    out2 = RequestManager(im2, GenerationConfig(max_new_tokens=8)).generate(
+        [prompt])[0]
+    assert out1 == out2
+
+
+def test_tp_spec_infer_with_pallas_kernel():
+    # TP x speculation: tree-verify kernel under shard_map
+    from flexflow_tpu.serve import ServeModelConfig, SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    im = make_im(max_tokens=32, max_requests=2, max_seq=64)
+    want = RequestManager(im, GenerationConfig(max_new_tokens=8)).generate(prompts)
+
+    tiny_ssm = ServeModelConfig(
+        model_type="llama", vocab_size=TINY.vocab_size, hidden_size=16,
+        intermediate_size=32, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2,
+    )
+    llm = make_im({"tp": 2}, max_tokens=32, max_requests=2, max_seq=64,
+                  max_spec=8, use_pallas=True)
+    ssm = make_im({"tp": 2}, max_tokens=32, max_requests=2, max_seq=64,
+                  max_spec=8, cfg=tiny_ssm, topk=2, seed=123, use_pallas=True)
+    sm = SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=8), width=2, depth=2
+    )
+    assert sm.generate(prompts) == want
 
 
 def test_e2e_decode_with_pallas_kernel():

@@ -1,0 +1,25 @@
+"""FFConfig.profiling -> jax.profiler trace artifact."""
+
+import glob
+import os
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.utils import profiling
+
+
+def test_fit_writes_trace(tmp_path, monkeypatch):
+    monkeypatch.setattr(profiling, "TRACE_DIR", str(tmp_path / "profile"))
+    cfg = FFConfig(batch_size=8, learning_rate=0.05)
+    cfg.profiling = True
+    model = FFModel(cfg)
+    x = model.create_tensor((8, 12))
+    model.softmax(model.dense(x, 4))
+    model.compile(optimizer=SGDOptimizer(lr=0.05))
+    rng = np.random.RandomState(0)
+    model.fit(rng.randn(16, 12).astype(np.float32),
+              rng.randint(0, 4, size=16).astype(np.int32),
+              epochs=1, batch_size=8, verbose=0)
+    traces = glob.glob(str(tmp_path / "profile" / "**" / "*"), recursive=True)
+    assert any(os.path.isfile(t) for t in traces), "no trace files written"

@@ -37,7 +37,7 @@ TINY = ServeModelConfig(
 
 
 def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
-            max_spec=0, cfg=TINY, topk=0, seed=7):
+            max_spec=0, cfg=TINY, topk=0, seed=7, use_pallas="auto"):
     axes = mesh_axes or {"tp": 1}
     n = int(np.prod(list(axes.values())))
     mesh = make_mesh(axes, jax.devices()[:n])
@@ -46,6 +46,7 @@ def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
     im = InferenceManager(
         ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
         max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
+        use_pallas=use_pallas,
     )
     im.init_operators_inference(rng=jax.random.PRNGKey(seed))
     return im
@@ -161,6 +162,69 @@ def test_eos_stops_generation():
     assert out == [first]
 
 
+def test_generate_uses_scan_and_matches_stepwise():
+    # the production generate() path (scan for pure-decode stretches) must
+    # emit exactly what the per-step loop emits, with far fewer host steps
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    im1 = make_im(max_seq=64)
+    rm1 = RequestManager(im1, GenerationConfig(max_new_tokens=12))
+    rm1.scan_chunk = 0  # force the per-step path
+    want = rm1.generate(prompts)
+    assert rm1.steps >= 12
+
+    im2 = make_im(max_seq=64)
+    rm2 = RequestManager(im2, GenerationConfig(max_new_tokens=12))
+    got = rm2.generate(prompts)
+    assert got == want
+    assert rm1.scan_runs == 0 and rm2.scan_runs >= 1, "scan path did not run"
+
+
+def test_generate_scan_respects_eos():
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    im = make_im(max_seq=64)
+    base = RequestManager(im, GenerationConfig(max_new_tokens=12)).generate(prompts)
+    eos = base[0][5]
+    im2 = make_im(max_seq=64)
+    rm = RequestManager(
+        im2, GenerationConfig(max_new_tokens=12, eos_token_id=eos)
+    )
+    got = rm.generate(prompts)
+    assert got[0] == base[0][: base[0].index(eos) + 1]
+    w1 = base[1]
+    if eos in w1:
+        w1 = w1[: w1.index(eos) + 1]
+    assert got[1] == w1
+
+
+def test_sampling_greedy_at_zero_temperature():
+    prompts = [[3, 11, 25, 40, 7]]
+    im1 = make_im(max_seq=64)
+    want = RequestManager(im1, GenerationConfig(max_new_tokens=10)).generate(prompts)
+    im2 = make_im(max_seq=64)
+    got = RequestManager(
+        im2, GenerationConfig(max_new_tokens=10, temperature=0.0, top_p=0.9)
+    ).generate(prompts)
+    assert got == want
+
+
+def test_sampling_seeded_and_deterministic():
+    prompts = [[3, 11, 25, 40, 7]]
+
+    def run(seed):
+        im = make_im(max_seq=64)
+        rm = RequestManager(
+            im, GenerationConfig(max_new_tokens=10, temperature=0.8,
+                                 top_p=0.9, seed=seed)
+        )
+        return rm.generate(prompts)
+
+    a, b, c = run(1), run(1), run(2)
+    assert a == b, "same seed must reproduce"
+    assert all(0 <= t < TINY.vocab_size for t in a[0])
+    # different seeds should (overwhelmingly) differ at T=0.8
+    assert a != c or len(a[0]) == 0
+
+
 def test_decode_scan_matches_stepwise():
     # the on-device multi-step decode loop must produce exactly the tokens
     # the host-driven per-step loop produces
@@ -195,7 +259,8 @@ def test_decode_scan_matches_stepwise():
         [first2], [0], [len(prompt)], [len(prompt) + 1],
         max_tokens=im2.max_tokens, max_requests=im2.max_requests,
     )
-    tokens, bc_out = im2.decode_scan(bc, n_new - 1)
+    tokens, live, bc_out = im2.decode_scan(bc, n_new - 1)
     got = [first2] + [int(t) for t in np.asarray(tokens)[:, 0]]
     assert got == want
+    assert np.asarray(live)[:, 0].all()
     assert int(bc_out.token_position[0]) == len(prompt) + n_new - 1

@@ -1,0 +1,188 @@
+"""GraphXfer substitution engine: per-rule equivalence + joint search.
+
+Strategy (SURVEY.md §2.4 substitution row; reference
+``src/runtime/substitution.cc`` unit tests): every rule's rewrite must be
+numerically equivalent on real graphs, weights must survive a rewrite, and
+the joint (rewrite + parallelization) search must never do worse than
+parallel-only search under the same cost model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, make_mesh
+from flexflow_tpu.core.pcg import PCG
+from flexflow_tpu.core.interpreter import build_forward, init_params
+from flexflow_tpu.models.transformer import build_transformer_classifier
+from flexflow_tpu.parallel.mesh import data_parallel_strategy
+from flexflow_tpu.search.search import graph_optimize
+from flexflow_tpu.search.simulator import simulate
+from flexflow_tpu.search.machine_model import MachineModel
+from flexflow_tpu.search.substitution import (
+    apply_match,
+    check_equivalence,
+    find_all_matches,
+    remap_params,
+    standard_rules,
+)
+
+
+def tiny_mesh():
+    return make_mesh({"dp": 1}, jax.devices()[:1])
+
+
+def transformer_graph():
+    model = build_transformer_classifier(
+        mesh=tiny_mesh(), batch=4, seq=8, num_layers=1, hidden_dim=32,
+        num_heads=4, ff_dim=64, num_classes=8,
+    )
+    return model
+
+
+def mlp_graph():
+    """dense -> relu (separate unary) -> dense -> softmax: exercises
+    fuse_linear_activation and eliminate_identity."""
+    model = FFModel(FFConfig(), mesh=tiny_mesh())
+    x = model.create_tensor((4, 16))
+    h = model.dense(x, 32)            # no fused activation
+    h = model.relu(h)
+    h = model.identity(h)
+    h = model.dense(h, 8)
+    model.softmax(h)
+    return model
+
+
+def swiglu_graph():
+    """silu(gate) * up junction: exercises fuse_silu_mul."""
+    model = FFModel(FFConfig(), mesh=tiny_mesh())
+    x = model.create_tensor((4, 16))
+    gate = model.dense(x, 32, name="gate_proj")
+    up = model.dense(x, 32, name="up_proj")
+    act = model.silu(gate)
+    h = model.multiply(act, up)
+    model.dense(h, 8, name="down_proj")
+    return model
+
+
+def out_tids(graph):
+    return [graph.nodes[-1].outputs[-1]]
+
+
+def rule_matches(graph, rule_name):
+    rules = [r for r in standard_rules() if r.name == rule_name]
+    assert rules, f"unknown rule {rule_name}"
+    return find_all_matches(graph, rules)
+
+
+@pytest.mark.parametrize("rule,builder", [
+    ("fuse_linear_activation", mlp_graph),
+    ("eliminate_identity", mlp_graph),
+    ("fuse_add_norm", transformer_graph),
+    ("fuse_silu_mul", swiglu_graph),
+])
+def test_rule_finds_and_preserves_semantics(rule, builder):
+    model = builder()
+    g = model.graph
+    matches = rule_matches(g, rule)
+    assert matches, f"{rule} found no matches on its target graph"
+    for m in matches:
+        res = apply_match(g, m)
+        assert len(res.graph.nodes) < len(g.nodes)
+        check_equivalence(g, res, out_tids(g), tiny_mesh())
+
+
+def test_chained_rewrites_remain_equivalent():
+    # apply every available rewrite greedily, re-finding after each
+    model = transformer_graph()
+    g = model.graph
+    n0 = len(g.nodes)
+    tids = out_tids(g)
+    applied = 0
+    while True:
+        matches = find_all_matches(g, standard_rules())
+        if not matches:
+            break
+        res = apply_match(g, matches[0])
+        check_equivalence(g, res, tids, tiny_mesh())
+        tids = [res.tid_map[t] for t in tids]
+        g = res.graph
+        applied += 1
+    assert applied >= 2, "transformer graph should admit multiple rewrites"
+    assert len(g.nodes) <= n0 - applied
+
+
+def test_params_survive_rewrite_in_training():
+    # train one step, rewrite, remap weights: forward outputs must match
+    model = mlp_graph()
+    model.compile(optimizer=SGDOptimizer(lr=0.01))
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(4, 16), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 8, size=4), jnp.int32)
+    tid = model.graph.input_tids[0]
+    p, s, loss, _ = model._train_step(
+        model.params, model.opt_state, {tid: X}, y, jax.random.PRNGKey(0)
+    )
+    before = model._forward(p, {tid: X})
+
+    g = model.graph
+    m = rule_matches(g, "fuse_linear_activation")[0]
+    res = apply_match(g, m)
+    p2 = remap_params(p, res, res.graph)
+    plan = PCG(res.graph, tiny_mesh(), {},
+               output_tids=[res.tid_map[t] for t in out_tids(g)]).plan()
+    after = build_forward(plan)(p2, {res.tid_map[tid]: X})
+    np.testing.assert_allclose(
+        np.asarray(before[0], np.float32), np.asarray(after[0], np.float32),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_joint_search_not_worse_than_parallel_only():
+    mesh = make_mesh({"dp": 2, "tp": 2}, jax.devices()[:4])
+    model = build_transformer_classifier(
+        mesh=mesh, batch=8, seq=16, num_layers=1, hidden_dim=64,
+        num_heads=4, ff_dim=128, num_classes=8,
+    )
+    g = model.graph
+    mm = MachineModel.for_mesh(mesh, spec_name="v5e")
+    dp = data_parallel_strategy(g, mesh)
+
+    par_only = graph_optimize(g, mesh, budget=120, machine=mm, seed=0, init=dp)
+    cost_par = simulate(PCG(g, mesh, par_only).plan(), mm).total
+
+    jg, js, jmap = graph_optimize(
+        g, mesh, budget=120, machine=mm, seed=0, init=dp,
+        substitution=True, output_tids=out_tids(g),
+    )
+    cost_joint = simulate(PCG(jg, mesh, js).plan(), mm).total
+    assert cost_joint <= cost_par * 1.0001, (
+        f"joint search ({cost_joint}) must not lose to parallel-only "
+        f"({cost_par})"
+    )
+    # the tid map must cover the protected outputs
+    for t in out_tids(g):
+        assert t in jmap
+
+
+def test_compile_with_search_budget_uses_joint_search():
+    # FFModel.compile with a search budget adopts the rewritten graph and
+    # still trains (loss decreases) — the end-to-end joint path
+    cfg = FFConfig(batch_size=4, learning_rate=0.05)
+    cfg.search_budget = 80
+    model = FFModel(cfg, mesh=tiny_mesh())
+    x = model.create_tensor((4, 16))
+    h = model.dense(x, 32)
+    h = model.relu(h)
+    h = model.dense(h, 8)
+    model.softmax(h)
+    n0 = len(model.graph.nodes)
+    model.compile(optimizer=SGDOptimizer(lr=0.05))
+    assert len(model.graph.nodes) < n0, "fuse_linear_activation not applied"
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 16).astype(np.float32)
+    y = rng.randint(0, 8, size=64).astype(np.int32)
+    hist = model.fit(X, y, epochs=8, batch_size=4, verbose=0)
+    assert hist[-1]["loss"] < hist[0]["loss"]

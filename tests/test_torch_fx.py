@@ -1,0 +1,130 @@
+"""torch.fx import frontend: align tests vs torch (SURVEY.md §2.6, §4).
+
+The reference's frontend tests (``tests/align``) compare per-op outputs and
+gradients between the frontend graph and native torch; same bar here:
+imported models must match torch forward outputs within tolerance, and a
+training step on the imported model must move the loss the same way.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import SGDOptimizer
+from flexflow_tpu.frontends import from_torch
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 24)
+        self.norm = nn.LayerNorm(24)
+        self.head = nn.Linear(24, 8)
+
+    def forward(self, x):
+        h = self.act(self.fc1(x))
+        h = self.norm(self.fc2(h))
+        return self.head(h)
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block (nn.MultiheadAttention, batch_first)."""
+
+    def __init__(self, e=32, h=4, ff=64):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(e)
+        self.attn = nn.MultiheadAttention(e, h, batch_first=True)
+        self.ln2 = nn.LayerNorm(e)
+        self.fc1 = nn.Linear(e, ff)
+        self.fc2 = nn.Linear(ff, e)
+
+    def forward(self, x):
+        a = self.ln1(x)
+        att, _ = self.attn(a, a, a)
+        x = x + att
+        h = torch.relu(self.fc1(self.ln2(x)))
+        return x + self.fc2(h)
+
+
+def import_and_run(module, shapes, inputs):
+    model, outs, weights = from_torch(module, shapes)
+    model.compile(optimizer=SGDOptimizer(lr=0.01), outputs=outs)
+    model.load_params(weights)
+    feeds = {tid: jnp.asarray(x) for tid, x in
+             zip(model.graph.input_tids, inputs)}
+    return model, np.asarray(model._forward(model.params, feeds)[0])
+
+
+def test_mlp_forward_matches_torch():
+    torch.manual_seed(0)
+    mod = MLP().eval()
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    with torch.no_grad():
+        want = mod(torch.from_numpy(x)).numpy()
+    _, got = import_and_run(mod, [(4, 16)], [x])
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_transformer_block_forward_matches_torch():
+    torch.manual_seed(1)
+    mod = Block().eval()
+    x = np.random.RandomState(1).randn(2, 6, 32).astype(np.float32)
+    with torch.no_grad():
+        want = mod(torch.from_numpy(x)).numpy()
+    _, got = import_and_run(mod, [(2, 6, 32)], [x])
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_imported_mlp_trains_like_torch():
+    # one SGD step on the same data: losses match before and after
+    torch.manual_seed(2)
+    mod = MLP()
+    rng = np.random.RandomState(2)
+    X = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 8, size=8).astype(np.int64)
+    lr = 0.1
+
+    # torch side
+    opt = torch.optim.SGD(mod.parameters(), lr=lr)
+    xt, yt = torch.from_numpy(X), torch.from_numpy(y)
+    loss0_t = nn.functional.cross_entropy(mod(xt), yt)
+    opt.zero_grad()
+    loss0_t.backward()
+    opt.step()
+    loss1_t = nn.functional.cross_entropy(mod(xt), yt).item()
+
+    # imported side (fresh copy of the ORIGINAL weights)
+    torch.manual_seed(2)
+    mod2 = MLP()
+    model, outs, weights = from_torch(mod2, [(8, 16)])
+    model.softmax(outs[0])  # loss head expects probabilities
+    model.compile(optimizer=SGDOptimizer(lr=lr))
+    model.load_params(weights)
+    tid = model.graph.input_tids[0]
+    p, s, loss0, _ = model._train_step(
+        model.params, model.opt_state, {tid: jnp.asarray(X)},
+        jnp.asarray(y.astype(np.int32)), jax.random.PRNGKey(0))
+    _, _, loss1, _ = model._train_step(
+        p, s, {tid: jnp.asarray(X)},
+        jnp.asarray(y.astype(np.int32)), jax.random.PRNGKey(0))
+    assert abs(float(loss0) - float(loss0_t.item())) < 1e-4
+    assert abs(float(loss1) - loss1_t) < 1e-3
+
+
+def test_unsupported_module_raises_with_name():
+    class Weird(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c = nn.ConvTranspose2d(1, 1, 2)
+
+        def forward(self, x):
+            return self.c(x)
+
+    with pytest.raises(NotImplementedError, match="ConvTranspose2d"):
+        from_torch(Weird(), [(1, 1, 4, 4)])
