@@ -150,7 +150,7 @@ def prefill_im(im, prompts):
             for r in range(len(prompts))]
 
 
-def bench_spec_decode(ctx=1800, width=1, depth=3, n_lo=4, n_hi=20,
+def bench_spec_decode(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
                       n_outer=3):
     """SpecInfer TPOT on device (north-star #2 currency).
 
@@ -266,27 +266,40 @@ def _train_step_time(model, X, y, iters=4):
     key = jax.random.PRNGKey(0)
 
     @functools.partial(jax.jit, static_argnames=("n",))
-    def train_n(p, s, n):
+    def train_n(p, s, salt, n):
         def body(c, _):
             p, s = c
-            p, s, loss, _ = model._train_step(p, s, {tid: xb}, yb, key)
+            p, s, loss, _ = model._train_step(
+                p, s, {tid: xb + salt}, yb, key)
             return (p, s), loss
 
         (p, s), losses = jax.lax.scan(body, (p, s), None, length=n)
         return losses[-1]
 
+    calls = [0]
+
+    def run(n):
+        # a fresh per-call input salt: every execution computes something
+        # new, so no layer of the (tunneled) runtime can replay a cached
+        # result instead of running the scan
+        calls[0] += 1
+        salt = jnp.float32(calls[0] * 1e-12)
+        return np.asarray(train_n(model.params, model.opt_state, salt, n))
+
     def best_of(n, k=iters):
-        np.asarray(train_n(model.params, model.opt_state, n))  # compile+warm
+        run(n)  # compile + warm
         best = float("inf")
         for _ in range(k):
             t0 = time.perf_counter()
-            np.asarray(train_n(model.params, model.opt_state, n))
+            run(n)
             best = min(best, time.perf_counter() - t0)
         return best
 
-    est = max((best_of(500, k=2) - 0.05) / 500, 2e-7)
-    n_hi = int(min(max(0.25 / est, 1000), 30000))
-    n_lo = n_hi // 10
+    # pre-estimate the step time from a rough slope (absolute times carry
+    # the ~100ms sync), then size the final slope for a ~0.35s signal
+    est = max((best_of(3000, k=2) - best_of(500, k=2)) / 2500, 2e-7)
+    n_hi = int(min(max(0.35 / est, 4000), 60000))
+    n_lo = max(n_hi // 10, 500)
     return (best_of(n_hi) - best_of(n_lo)) / (n_hi - n_lo)
 
 

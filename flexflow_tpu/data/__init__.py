@@ -1,0 +1,6 @@
+"""Data-loading subsystem (reference: ``SingleDataLoader`` + the python
+``DataLoader`` helpers)."""
+
+from .loader import DataLoader
+
+__all__ = ["DataLoader"]

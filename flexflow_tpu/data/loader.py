@@ -1,0 +1,85 @@
+"""Batched, shuffled, device-prefetching data loader.
+
+Reference: ``SingleDataLoader`` (``src/loc/loader.cc`` + the
+``flexflow.core`` python wrappers) — the reference stages numpy batches into
+pinned buffers and overlaps H2D copies with compute.  The TPU-native
+equivalent: an iterator that slices numpy arrays, places each batch on
+device with the plan's input shardings (``place_inputs``), and keeps
+``prefetch`` batches in flight — JAX dispatch is async, so simply issuing
+the ``device_put`` ahead of consumption overlaps the transfer with the
+running step.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataLoader:
+    """Iterate ``(inputs_dict, labels)`` device batches over numpy data.
+
+    ``x``: array, list of arrays (multi-input), or {tid: array}.
+    Drops the trailing ragged batch (fixed shapes keep XLA to one program).
+    """
+
+    def __init__(self, x, y, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, prefetch: int = 2, plan=None):
+        if isinstance(x, dict):
+            self.inputs = {k: np.asarray(v) for k, v in x.items()}
+        elif isinstance(x, (list, tuple)):
+            self.inputs = {i: np.asarray(v) for i, v in enumerate(x)}
+        else:
+            self.inputs = {0: np.asarray(x)}
+        self.y = np.asarray(y)
+        n = len(self.y)
+        for v in self.inputs.values():
+            if len(v) != n:
+                raise ValueError("inputs and labels disagree on length")
+        self.n = n
+        self.batch_size = int(batch_size)
+        self.shuffle = bool(shuffle)
+        self.rng = np.random.RandomState(seed)
+        self.prefetch = max(int(prefetch), 1)
+        self.plan = plan
+
+    def __len__(self) -> int:
+        return self.n // self.batch_size
+
+    def _place(self, batch: Dict, labels: np.ndarray):
+        arrs = {k: jnp.asarray(v) for k, v in batch.items()}
+        if self.plan is not None:
+            from ..core.interpreter import place_inputs
+
+            arrs = place_inputs(self.plan, arrs)
+        return arrs, jnp.asarray(labels)
+
+    def __iter__(self) -> Iterator:
+        idx = (self.rng.permutation(self.n) if self.shuffle
+               else np.arange(self.n))
+        starts = range(0, self.n - self.batch_size + 1, self.batch_size)
+        queue: collections.deque = collections.deque()
+        it = iter(starts)
+
+        def enqueue():
+            try:
+                s = next(it)
+            except StopIteration:
+                return False
+            sel = idx[s: s + self.batch_size]
+            queue.append(self._place(
+                {k: v[sel] for k, v in self.inputs.items()}, self.y[sel]
+            ))
+            return True
+
+        for _ in range(self.prefetch):
+            if not enqueue():
+                break
+        while queue:
+            out = queue.popleft()
+            enqueue()
+            yield out

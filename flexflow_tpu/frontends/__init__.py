@@ -1,11 +1,12 @@
 """Import frontends: foreign model definitions -> FFModel graphs.
 
 Reference: ``python/flexflow/torch`` (fx tracing), ``python/flexflow/keras``
-and ``python/flexflow/onnx`` in the reference tree.  torch.fx is the
-implemented one (the reference's example ports are torch-first); Keras/ONNX
-remain out of scope this round.
+and ``python/flexflow/onnx`` in the reference tree.  torch.fx and the
+Keras-style Sequential surface are implemented; ONNX stays out of scope
+(the onnx package is not available in this environment).
 """
 
+from . import keras
 from .torch_fx import from_torch
 
-__all__ = ["from_torch"]
+__all__ = ["from_torch", "keras"]

@@ -1,0 +1,174 @@
+"""Keras-style frontend: Sequential + layer objects over FFModel.
+
+Reference: ``python/flexflow/keras`` — the reference re-implements the Keras
+``Sequential``/``Model`` surface on top of FFModel so Keras scripts port by
+changing an import.  Same shape here: layers record their config, ``build``
+emits the corresponding FFModel graph, and compile/fit/evaluate/predict
+delegate to the native training loop (so search/PCG/GSPMD apply unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import FFConfig
+from ..model import FFModel
+from ..training.optimizer import AdamOptimizer, SGDOptimizer
+
+
+class Layer:
+    def __call__(self, model: FFModel, x):
+        raise NotImplementedError
+
+
+class Input(Layer):
+    def __init__(self, shape: Sequence[int], dtype="float32"):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation: Optional[str] = None,
+                 use_bias: bool = True, input_shape=None, name=None):
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.name = name
+
+    def __call__(self, model, x):
+        act = None if self.activation in (None, "softmax") else self.activation
+        out = model.dense(x, self.units, activation=act,
+                          use_bias=self.use_bias, name=self.name)
+        if self.activation == "softmax":
+            out = model.softmax(out)
+        return out
+
+
+class Activation(Layer):
+    def __init__(self, fn: str):
+        self.fn = fn
+
+    def __call__(self, model, x):
+        if self.fn == "softmax":
+            return model.softmax(x)
+        return getattr(model, self.fn)(x)
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    def __call__(self, model, x):
+        return model.dropout(x, self.rate)
+
+
+class Flatten(Layer):
+    def __call__(self, model, x):
+        return model.flat(x)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, input_shape=None):
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.dtype = "int32"
+
+    def __call__(self, model, x):
+        return model.embedding(x, self.input_dim, self.output_dim)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-5):
+        self.epsilon = float(epsilon)
+
+    def __call__(self, model, x):
+        return model.layer_norm(x, eps=self.epsilon)
+
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGDOptimizer(lr=0.01),
+    "adam": lambda: AdamOptimizer(),
+}
+
+_LOSSES = {
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "categorical_crossentropy": "categorical_crossentropy",
+    "mean_squared_error": "mean_squared_error",
+    "mse": "mean_squared_error",
+}
+
+
+class Sequential:
+    """``keras.Sequential`` work-alike over FFModel."""
+
+    def __init__(self, layers: Optional[List[Layer]] = None,
+                 config: Optional[FFConfig] = None, mesh=None):
+        self.layers: List[Layer] = []
+        self.config = config
+        self.mesh = mesh
+        self.model: Optional[FFModel] = None
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer: Layer):
+        if self.model is not None:
+            raise RuntimeError("cannot add layers after compile()")
+        self.layers.append(layer)
+
+    def _build(self, batch_size: int):
+        layers = list(self.layers)
+        if layers and isinstance(layers[0], Input):
+            inp = layers.pop(0)
+            shape, dtype = inp.shape, inp.dtype
+        else:
+            first = layers[0]
+            shape = getattr(first, "input_shape", None)
+            if shape is None:
+                raise ValueError(
+                    "give the first layer an input_shape= (or start with "
+                    "Input(shape))"
+                )
+            dtype = getattr(first, "dtype", "float32")
+        model = FFModel(self.config or FFConfig(batch_size=batch_size),
+                        mesh=self.mesh)
+        x = model.create_tensor((batch_size,) + tuple(shape), dtype)
+        for l in layers:
+            x = l(model, x)
+        return model, x
+
+    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
+                metrics: Sequence[str] = (), batch_size: int = 32):
+        if isinstance(optimizer, str):
+            try:
+                optimizer = _OPTIMIZERS[optimizer.lower()]()
+            except KeyError:
+                raise ValueError(f"unknown optimizer {optimizer!r}")
+        if loss not in _LOSSES:
+            raise ValueError(f"unknown loss {loss!r}")
+        self.model, out = self._build(batch_size)
+        self.model.compile(optimizer=optimizer, loss_type=_LOSSES[loss],
+                           metrics=list(metrics), outputs=[out])
+        return self
+
+    # -- training API ----------------------------------------------------
+    def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
+            verbose: bool = True, shuffle: bool = True):
+        assert self.model is not None, "call compile() first"
+        return self.model.fit(x, y, epochs=epochs, batch_size=batch_size,
+                              verbose=verbose, shuffle=shuffle)
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        assert self.model is not None, "call compile() first"
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x):
+        assert self.model is not None, "call compile() first"
+        import jax.numpy as jnp
+
+        feeds = {tid: jnp.asarray(v) for tid, v in
+                 self.model._standardize_inputs(x).items()}
+        return np.asarray(self.model._forward(self.model.params, feeds)[0])

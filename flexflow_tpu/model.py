@@ -478,6 +478,46 @@ class FFModel:
             self.opt_state = jax.tree.map(place, self.opt_state)
         return self
 
+    def recompile(
+        self,
+        strategy: Optional[Dict[str, Dict]] = None,
+        optimizer: Optional[Optimizer] = None,
+        mode: str = "spmd",
+        outputs: Optional[Sequence[Tensor]] = None,
+    ) -> "FFModel":
+        """Re-plan the SAME graph under a new strategy (and optionally a new
+        optimizer), keeping trained params.
+
+        Reference: ``RecompileState`` / ``FFModel::recompile`` — runtime
+        re-optimization (e.g. adopting a strategy the search found after
+        training started, or moving to a different mesh layout).  Params are
+        re-placed under the new plan's shardings; optimizer state carries
+        over when the optimizer is unchanged, and resets otherwise.
+        """
+        old_params = self.params
+        old_opt = self.opt_state if optimizer is None else None
+        self.compile(
+            optimizer=optimizer or self.optimizer,
+            loss_type=self.loss_type,
+            metrics=self.metric_names,
+            strategy=strategy,
+            mode=mode,
+            outputs=outputs,
+        )
+        if old_params is not None:
+            # live device arrays pass straight through load_params (it
+            # casts + re-places); no host round trip
+            self.load_params(old_params)
+        if old_opt is not None:
+            def carry(new, old):
+                arr = jnp.asarray(np.asarray(old), new.dtype)
+                if hasattr(new, "sharding"):
+                    arr = jax.device_put(arr, new.sharding)
+                return arr
+
+            self.opt_state = jax.tree.map(carry, self.opt_state, old_opt)
+        return self
+
     def load_params(self, weights) -> "FFModel":
         """Merge imported weight arrays into ``self.params`` (post-compile).
 
@@ -529,7 +569,11 @@ class FFModel:
             return self._fit(x, y, epochs, batch_size, verbose, shuffle)
 
     def _fit(self, x, y, epochs, batch_size, verbose, shuffle):
+        from .data import DataLoader
+
         epochs = epochs or self.config.epochs
+        if isinstance(x, DataLoader):
+            return self._fit_loader(x, epochs, verbose)
         bs = batch_size or self.config.batch_size
         inputs = self._standardize_inputs(x)
         n = len(y)
@@ -544,37 +588,74 @@ class FFModel:
                 idx = np.random.RandomState(seed).permutation(n)
             else:
                 idx = np.arange(n)
-            losses, mets_acc = [], []
-            t0 = time.perf_counter()
-            for start in range(0, n - bs + 1, bs):
-                sel = idx[start : start + bs]
-                batch = {
-                    tid: jnp.asarray(v[sel]) for tid, v in inputs.items()
-                }
-                batch = place_inputs(self.plan, batch)
-                labels = jnp.asarray(y[sel])
-                ek, sk = jax.random.split(ek)
-                self.params, self.opt_state, loss, mets = self._train_step(
-                    self.params, self.opt_state, batch, labels, sk
-                )
-                losses.append(loss)
-                mets_acc.append(mets)
-            jax.block_until_ready(losses[-1])
-            dt = time.perf_counter() - t0
-            mean_loss = float(np.mean([float(l) for l in losses]))
-            mean_mets = {
-                k: float(np.mean([float(m[k]) for m in mets_acc]))
-                for k in (mets_acc[0] if mets_acc else {})
-            }
-            steps = len(losses)
-            history.append({"loss": mean_loss, **mean_mets})
-            if verbose:
-                print(
-                    f"epoch {epoch + 1}/{epochs}: loss={mean_loss:.4f} "
-                    + " ".join(f"{k}={v:.4f}" for k, v in mean_mets.items())
-                    + f" ({steps / dt:.1f} it/s, {steps * bs / dt:.0f} samples/s)"
-                )
+
+            def batches():
+                for start in range(0, n - bs + 1, bs):
+                    sel = idx[start: start + bs]
+                    batch = {
+                        tid: jnp.asarray(v[sel]) for tid, v in inputs.items()
+                    }
+                    yield place_inputs(self.plan, batch), jnp.asarray(y[sel])
+
+            history.append(
+                self._train_epoch(batches(), ek, epoch, epochs, verbose, bs)
+            )
         return history
+
+    def _fit_loader(self, loader, epochs, verbose):
+        """Epoch loop over a :class:`flexflow_tpu.data.DataLoader` (device
+        prefetch overlaps H2D with compute; the loader owns batching).
+
+        The loader's ``{key: array}`` inputs map onto graph input tids by
+        position (or directly when the keys ARE tids)."""
+        tids = self.graph.input_tids
+        history = []
+        for epoch in range(epochs):
+            self._rng, ek = jax.random.split(self._rng)
+
+            def batches():
+                for arrs, labels in loader:
+                    keys = list(arrs)
+                    batch = {t: arrs[k] for t, k in zip(tids, keys)} \
+                        if set(keys) != set(tids) else arrs
+                    yield batch, labels
+
+            history.append(self._train_epoch(
+                batches(), ek, epoch, epochs, verbose, loader.batch_size
+            ))
+        return history
+
+    def _train_epoch(self, batch_iter, ek, epoch, epochs, verbose, bs):
+        """One epoch over ``(batch, labels)`` pairs; returns history entry."""
+        losses, mets_acc = [], []
+        t0 = time.perf_counter()
+        for batch, labels in batch_iter:
+            ek, sk = jax.random.split(ek)
+            self.params, self.opt_state, loss, mets = self._train_step(
+                self.params, self.opt_state, batch, labels, sk
+            )
+            losses.append(loss)
+            mets_acc.append(mets)
+        if not losses:
+            raise ValueError(
+                "no full batches to train on — dataset smaller than the "
+                "batch size?"
+            )
+        jax.block_until_ready(losses[-1])
+        dt = time.perf_counter() - t0
+        mean_loss = float(np.mean([float(l) for l in losses]))
+        mean_mets = {
+            k: float(np.mean([float(m[k]) for m in mets_acc]))
+            for k in (mets_acc[0] if mets_acc else {})
+        }
+        if verbose:
+            steps = len(losses)
+            print(
+                f"epoch {epoch + 1}/{epochs}: loss={mean_loss:.4f} "
+                + " ".join(f"{k}={v:.4f}" for k, v in mean_mets.items())
+                + f" ({steps / dt:.1f} it/s, {steps * bs / dt:.0f} samples/s)"
+            )
+        return {"loss": mean_loss, **mean_mets}
 
     def evaluate(self, x, y, batch_size: Optional[int] = None):
         assert self._eval_fn is not None, "call compile() first"

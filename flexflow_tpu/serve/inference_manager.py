@@ -125,9 +125,9 @@ class InferenceManager:
         else:
             self.use_pallas = bool(use_pallas)
         self.pallas_interpret = backend != "tpu"
-        # fixed tree-token layout (rows, slots) for tree-verify batches; set
-        # by SpecDecodeScan BEFORE the first tree step is traced (init-only,
-        # like use_pallas) — enables the batched tree kernel
+        # fixed tree-token layout (rows, slots) registered by SpecDecodeScan
+        # (one per InferenceManager); the layout is PASSED per step by the
+        # scan, never applied to host-built tree batches
         self.tree_token_layout: Optional[Tuple[int, int]] = None
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
         self._scan = jax.jit(
@@ -209,7 +209,11 @@ class InferenceManager:
 
         return jax.lax.cond(temperature <= 0.0, lambda _: greedy, draw, None)
 
-    def _step_impl(self, params, state, bc, sample=None):
+    def _step_impl(self, params, state, bc, sample=None, tree_layout=None):
+        # ``tree_layout`` is passed ONLY by SpecDecodeScan, whose verify
+        # batches are guaranteed slot-major [R, P]; host-built tree batches
+        # (SpecInferManager) have variable layouts and must not take the
+        # batched-kernel path
         base = bc if isinstance(bc, BatchConfig) else bc.base
         outs, new_state = self._fwd(
             params,
@@ -219,7 +223,7 @@ class InferenceManager:
                 "batch_config": bc,
                 "pallas_decode": self.use_pallas,
                 "pallas_interpret": self.pallas_interpret,
-                "tree_layout": self.tree_token_layout
+                "tree_layout": tree_layout
                 if not isinstance(bc, BatchConfig) else None,
             },
         )

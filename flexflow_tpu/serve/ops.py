@@ -274,8 +274,10 @@ class IncMultiHeadSelfAttention(Op):
         to feed the Pallas kernel's default-layout operand.
         For large token counts (prefill chunks) the unrolled DUS chain would
         bloat compile time and serialize, so fall back to one XLA scatter —
-        the layout concern only bites inside the decode scan, whose batches
-        are at most ``max_requests`` tokens.
+        the layout concern only bites inside the decode/spec scans, whose
+        batches are at most ``max_requests`` tokens (decode) or the commit
+        descriptor's ``max_requests*(depth+1)`` entries (spec macro-step);
+        the 64 threshold keeps both on the DUS path.
         cache: [R, H, S, D], updates: [T, H, D].
         """
         t, h, d = updates.shape
@@ -285,7 +287,7 @@ class IncMultiHeadSelfAttention(Op):
         # undefined behavior for a hand-built BatchConfig with bad positions.
         rows = jnp.clip(rows.astype(jnp.int32), 0, cache.shape[0] - 1)
         pos = jnp.clip(pos.astype(jnp.int32), 0, cache.shape[2] - 1)
-        if t > 32:
+        if t > 64:
             idx = jnp.stack([rows, pos], axis=-1)
             dnums = jax.lax.ScatterDimensionNumbers(
                 update_window_dims=(1, 2),
@@ -371,6 +373,11 @@ class IncMultiHeadSelfAttention(Op):
 
             t = q.shape[0]
             interp = bool(ctx.extras.get("pallas_interpret"))
+            # pad tokens (scratch row) otherwise stream a full cache row
+            # each — their position is whatever the builder left there, and
+            # the kernel's DMA clamp follows it; zero it so they fetch one
+            # block (outputs are discarded anyway)
+            pos = jnp.where(rows == nreq, 0, pos)
             slopes = alibi_slopes(self.num_q_heads).reshape(
                 self.num_kv_heads, self.q_per_kv
             )  # [KV, gq]: shardable over the kv-head dim
@@ -479,7 +486,10 @@ class IncMultiHeadSelfAttention(Op):
 
             t = q.shape[0]
             interp = bool(ctx.extras.get("pallas_interpret"))
-            clens = bc.committed_lens[rows]     # scratch row clamps to last
+            # scratch-row (pad) tokens get a zero committed frontier so the
+            # kernel's DMA clamp fetches one block for them, not the full
+            # cache depth of whatever request the index clamp landed on
+            clens = jnp.where(rows == nreq, 0, bc.committed_lens[rows])
             amask = bc.ancestor_mask[rows, spec_idx]
             # fixed [R, P] token layout (the on-device spec scan): all P
             # tree tokens of a request share one kernel grid row, so the

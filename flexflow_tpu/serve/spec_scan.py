@@ -270,7 +270,7 @@ class SpecDecodeScan:
                 jnp.where(commit_valid, c["commit_dst"], 0), cap_l, 0),
         )
         res_v, llm_state = self.llm._step_impl(
-            llm_params, c["llm_state"], bc_v)
+            llm_params, c["llm_state"], bc_v, tree_layout=(R, P))
         ids2 = res_v.token_ids[: R * P].reshape(R, P)              # [R, P]
 
         # ---- 4. greedy accept walk ----

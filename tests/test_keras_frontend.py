@@ -1,0 +1,56 @@
+"""Keras-style Sequential frontend (reference: python/flexflow/keras)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.frontends.keras import (
+    Activation,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    Sequential,
+)
+
+
+def test_sequential_mlp_trains():
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 16).astype(np.float32)
+    w = rng.randn(16, 4)
+    y = np.argmax(X @ w, axis=1).astype(np.int32)
+
+    m = Sequential([
+        Dense(64, activation="relu", input_shape=(16,)),
+        Dropout(0.0),
+        Dense(4, activation="softmax"),
+    ])
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"], batch_size=16)
+    hist = m.fit(X, y, epochs=20, batch_size=16, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    ev = m.evaluate(X, y, batch_size=16)
+    assert ev["accuracy"] > 0.6
+    probs = m.predict(X[:16])
+    assert probs.shape == (16, 4)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
+
+
+def test_sequential_embedding_input():
+    rng = np.random.RandomState(1)
+    X = rng.randint(0, 50, size=(64,)).astype(np.int32)
+    y = (X % 3).astype(np.int32)
+    m = Sequential([
+        Input(shape=(), dtype="int32"),
+        Embedding(50, 16),
+        Dense(3, activation="softmax"),
+    ])
+    m.compile(optimizer="adam", batch_size=16)
+    hist = m.fit(X, y, epochs=10, batch_size=16, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_unknown_optimizer_raises():
+    m = Sequential([Dense(4, input_shape=(8,))])
+    with pytest.raises(ValueError, match="optimizer"):
+        m.compile(optimizer="adagrad")
