@@ -402,6 +402,8 @@ def searched_vs_dp_fields():
         doc = json.loads(proc.stdout.strip().splitlines()[-1])
         return {
             "searched_vs_dp_sim": doc["searched_vs_dp_sim"],
+            "joint_vs_dp_sim": doc.get("joint_vs_dp_sim"),
+            "rewrites_accepted": doc.get("rewrites_accepted"),
             "searched_vs_dp_wallclock": doc["searched_vs_dp_wallclock"],
         }
     except Exception as e:  # bench must still print its line

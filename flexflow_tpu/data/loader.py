@@ -28,7 +28,8 @@ class DataLoader:
     """
 
     def __init__(self, x, y, batch_size: int, shuffle: bool = True,
-                 seed: int = 0, prefetch: int = 2, plan=None):
+                 seed: int = 0, prefetch: int = 2, plan=None,
+                 native: object = "auto"):
         if isinstance(x, dict):
             self.inputs = {k: np.asarray(v) for k, v in x.items()}
         elif isinstance(x, (list, tuple)):
@@ -43,9 +44,18 @@ class DataLoader:
         self.n = n
         self.batch_size = int(batch_size)
         self.shuffle = bool(shuffle)
+        self.seed = int(seed)
         self.rng = np.random.RandomState(seed)
         self.prefetch = max(int(prefetch), 1)
         self.plan = plan
+        # native C++ staging engine (flexflow_tpu/native/dataloader.cc):
+        # GIL-free background gather for the single-input case; "auto"
+        # falls back to the Python path when the library can't build.
+        # NOTE: the native engine uses its own RNG stream, so epoch order
+        # differs from the Python path for the same seed.
+        self.native = native
+        self._nb = None
+        self._nb_pos = 0
 
     def __len__(self) -> int:
         return self.n // self.batch_size
@@ -58,7 +68,55 @@ class DataLoader:
             arrs = place_inputs(self.plan, arrs)
         return arrs, jnp.asarray(labels)
 
+    def _native_iter(self) -> Optional[Iterator]:
+        if self.native not in ("auto", True):
+            return None
+        if len(self.inputs) != 1:
+            if self.native is True:
+                raise RuntimeError(
+                    "native dataloader supports a single input array; got "
+                    f"{len(self.inputs)}"
+                )
+            return None
+        from . import native
+
+        if not native.available():
+            if self.native is True:
+                raise RuntimeError("native dataloader requested but the "
+                                   "library could not be built")
+            return None
+        if self._nb is not None and self._nb_pos % len(self) != 0:
+            # a previous iteration stopped mid-epoch; the engine's stream
+            # is mid-permutation — restart it so every __iter__ delivers
+            # one clean epoch (each sample exactly once)
+            self._nb.close()
+            self._nb = None
+        if self._nb is None:
+            (key, arr), = self.inputs.items()
+            self._nkey = key
+            self._nb_pos = 0
+            self._nb = native.NativeBatcher(
+                arr, self.y, self.batch_size, shuffle=self.shuffle,
+                seed=self.seed, prefetch=self.prefetch,
+            )
+
+        def gen():
+            for _ in range(len(self)):
+                xb, yb, _ = self._nb.next()
+                self._nb_pos += 1
+                # own the data before the engine reuses its staging buffer
+                # (device_put can alias host memory on the CPU backend)
+                yield self._place({self._nkey: np.array(xb)}, np.array(yb))
+
+        return gen()
+
     def __iter__(self) -> Iterator:
+        it = self._native_iter()
+        if it is not None:
+            return it
+        return self._python_iter()
+
+    def _python_iter(self) -> Iterator:
         idx = (self.rng.permutation(self.n) if self.shuffle
                else np.arange(self.n))
         starts = range(0, self.n - self.batch_size + 1, self.batch_size)

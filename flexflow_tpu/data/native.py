@@ -1,0 +1,132 @@
+"""ctypes binding for the native batch-staging engine.
+
+Reference: the C++ dataloader tasks in the reference runtime; see
+``flexflow_tpu/native/dataloader.cc``.  The shared library is built on
+demand (``make -C flexflow_tpu/native``); when no toolchain is available
+the DataLoader silently stays on its pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libffdl.so"))
+_lib = None
+_lib_failed = False
+_lib_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:  # don't re-run make on every available() call
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                    capture_output=True, check=True, timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.ffdl_create.restype = ctypes.c_void_p
+        lib.ffdl_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
+        ]
+        lib.ffdl_batches_per_epoch.restype = ctypes.c_int64
+        lib.ffdl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.ffdl_next.restype = ctypes.c_int64
+        lib.ffdl_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.ffdl_destroy.restype = None
+        lib.ffdl_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeBatcher:
+    """Background-threaded shuffled batch gather over one (x, y) pair.
+
+    Rows are memcpy'd by the C++ worker without the GIL; each ``next()``
+    returns numpy views over the engine's staging buffer (valid until the
+    following ``next()``), which the caller immediately ships to device.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, batch: int,
+                 shuffle: bool = True, seed: int = 0, prefetch: int = 2):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native dataloader library unavailable")
+        self._lib = lib
+        # keep contiguous copies alive for the engine's lifetime
+        self._x = np.ascontiguousarray(x)
+        self._y = np.ascontiguousarray(y)
+        self.batch = int(batch)
+        self.x_shape = (self.batch,) + self._x.shape[1:]
+        self.y_shape = (self.batch,) + self._y.shape[1:]
+        row_bytes = self._x.dtype.itemsize * int(
+            np.prod(self._x.shape[1:], dtype=np.int64) or 1)
+        label_bytes = self._y.dtype.itemsize * int(
+            np.prod(self._y.shape[1:], dtype=np.int64) or 1)
+        self._h = lib.ffdl_create(
+            self._x.ctypes.data_as(ctypes.c_void_p),
+            self._y.ctypes.data_as(ctypes.c_void_p),
+            len(self._x), row_bytes, label_bytes, self.batch,
+            int(prefetch), int(bool(shuffle)), int(seed) & (2**64 - 1),
+        )
+        if not self._h:
+            raise ValueError("bad dataloader arguments")
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._lib.ffdl_batches_per_epoch(self._h))
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(x_batch, y_batch, epoch) — views into the staging buffer."""
+        px, py = ctypes.c_void_p(), ctypes.c_void_p()
+        epoch = self._lib.ffdl_next(
+            self._h, ctypes.byref(px), ctypes.byref(py))
+        xb = np.ctypeslib.as_array(
+            ctypes.cast(px, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(int(np.prod(self.x_shape)) * self._x.dtype.itemsize,),
+        ).view(self._x.dtype).reshape(self.x_shape)
+        yb = np.ctypeslib.as_array(
+            ctypes.cast(py, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(int(np.prod(self.y_shape)) * self._y.dtype.itemsize,),
+        ).view(self._y.dtype).reshape(self.y_shape)
+        return xb, yb, int(epoch)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ffdl_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -416,6 +416,10 @@ class FFModel:
             from .search.strategy import save_strategy
 
             save_strategy(cfg.export_strategy_file, strategy)
+        # stash the resolved strategy/outputs so recompile() can preserve
+        # them (its contract: re-plan the SAME graph)
+        self.strategy = strategy
+        self._compiled_out_tids = out_tids
         self.pcg = PCG(self.graph, mesh, strategy, output_tids=out_tids)
         self.plan = self.pcg.plan()
         self._forward = build_forward(self.plan, mode=mode)
@@ -496,6 +500,15 @@ class FFModel:
         """
         old_params = self.params
         old_opt = self.opt_state if optimizer is None else None
+        if strategy is None:
+            # keep the previously resolved strategy rather than re-running
+            # resolution (which could fall back to data-parallel or rerun
+            # the graph-rewriting search)
+            strategy = self.strategy
+        if outputs is None:
+            out_tids = getattr(self, "_compiled_out_tids", None)
+            if out_tids:
+                outputs = [Tensor(self.graph, t) for t in out_tids]
         self.compile(
             optimizer=optimizer or self.optimizer,
             loss_type=self.loss_type,

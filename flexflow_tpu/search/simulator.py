@@ -51,7 +51,11 @@ def _local_size(spec, sh, mesh) -> int:
 
 def _step_compute_time(step: Step, mesh, mm: MachineModel,
                        measured: Optional[Dict] = None,
-                       training: bool = True) -> float:
+                       training: bool = True,
+                       param_bytes: float = 0.0) -> float:
+    """``param_bytes``: the op's local weight bytes — streamed from HBM once
+    per step, part of the roofline's memory traffic (measured probes already
+    include them implicitly)."""
     op = step.node.op
     # measured-cost cache lookup (op signature + local shapes); ``measured``
     # is a CostCache (repr-string keys) or any mapping supporting __contains__
@@ -74,7 +78,7 @@ def _step_compute_time(step: Step, mesh, mm: MachineModel,
             shard_frac /= mesh.shape[a]
     flops = global_flops * shard_frac
 
-    bytes_accessed = 0
+    bytes_accessed = param_bytes
     for spec, sh in zip(step.in_specs, step.in_shardings):
         bytes_accessed += _local_size(spec, sh, mesh) * spec.nbytes() // max(spec.size, 1)
     for spec, sh in zip(step.out_specs, step.out_shardings):
@@ -84,6 +88,17 @@ def _step_compute_time(step: Step, mesh, mm: MachineModel,
     fwd = mm.compute_time(flops, bytes_accessed, dtype_bits)
     # backward ≈ 2× forward flops (dX and dW matmuls); elementwise ≈ 1×
     return fwd * (3.0 if training else 1.0)
+
+
+def _step_param_bytes(step: Step, plan: Plan, mesh) -> float:
+    """Local (per-device) weight bytes the op streams each step."""
+    pshs = plan.param_shardings.get(step.node.name, {})
+    total = 0.0
+    for p in step.node.op.params():
+        sh = pshs.get(p.name)
+        n = _local_size(p.spec, sh, mesh) if sh is not None else p.spec.size
+        total += n * (p.spec.nbytes() // max(p.spec.size, 1))
+    return total
 
 
 def _measure_key(step: Step, mesh):
@@ -151,7 +166,10 @@ def simulate(
                 t *= 2.0
             cost.comm += t
         else:
-            cost.compute += _step_compute_time(step, mesh, mm, measured, training)
+            cost.compute += _step_compute_time(
+                step, mesh, mm, measured, training,
+                param_bytes=_step_param_bytes(step, plan, mesh),
+            )
 
     if training:
         # gradient all-reduce: params replicated over axes that shard the

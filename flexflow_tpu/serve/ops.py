@@ -45,6 +45,14 @@ from .batch_config import (
 
 NEG_INF = -1e30
 
+# token-count cutoff between the per-token dynamic-update-slice chain and a
+# single XLA scatter for KV-cache writes (see _scatter_rows_pos); decode
+# batches (<= max_requests) and spec commit descriptors
+# (<= max_requests * (depth+1)) must stay under it or they silently take
+# the scatter path, whose layout choice forces a per-step full-cache
+# relayout inside the decode/spec scans — SpecDecodeScan checks at init.
+DUS_MAX_TOKENS = 128
+
 
 def alibi_slopes(num_heads: int) -> jax.Array:
     """ALiBi per-head slopes (Press et al.; matches HF's power-of-2 recipe)."""
@@ -277,7 +285,7 @@ class IncMultiHeadSelfAttention(Op):
         the layout concern only bites inside the decode/spec scans, whose
         batches are at most ``max_requests`` tokens (decode) or the commit
         descriptor's ``max_requests*(depth+1)`` entries (spec macro-step);
-        the 64 threshold keeps both on the DUS path.
+        the DUS_MAX_TOKENS threshold keeps both on the DUS path.
         cache: [R, H, S, D], updates: [T, H, D].
         """
         t, h, d = updates.shape
@@ -287,7 +295,7 @@ class IncMultiHeadSelfAttention(Op):
         # undefined behavior for a hand-built BatchConfig with bad positions.
         rows = jnp.clip(rows.astype(jnp.int32), 0, cache.shape[0] - 1)
         pos = jnp.clip(pos.astype(jnp.int32), 0, cache.shape[2] - 1)
-        if t > 64:
+        if t > DUS_MAX_TOKENS:
             idx = jnp.stack([rows, pos], axis=-1)
             dnums = jax.lax.ScatterDimensionNumbers(
                 update_window_dims=(1, 2),

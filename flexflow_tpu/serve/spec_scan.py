@@ -92,6 +92,15 @@ class SpecDecodeScan:
             )
         if ssm.topk < self.width:
             raise ValueError(f"SSM needs topk >= width ({self.width})")
+        from .ops import DUS_MAX_TOKENS
+
+        if R * (self.depth + 1) > DUS_MAX_TOKENS:
+            raise ValueError(
+                f"commit descriptor ({R}x{self.depth + 1} entries) exceeds "
+                f"the KV-write DUS threshold ({DUS_MAX_TOKENS}); the scatter "
+                "fallback forces a per-macro-step full-cache relayout — use "
+                "fewer request slots or a shallower tree"
+            )
         # the verify batch always ships exactly n_tree tokens per request in
         # slot-major order -> the LLM can use the batched tree kernel (the
         # committed cache streams once per request, not once per tree token).
