@@ -247,15 +247,17 @@ def bench_mlp_train(batch: int = 64):
     rng = np.random.RandomState(0)
     X = rng.randn(batch, 784).astype(np.float32)
     y = rng.randint(0, 10, size=batch).astype(np.int32)
-    return batch / _train_step_time(model, X, y)
+    return batch / _train_step_time(model, X, y, n_pair=(3000, 30000))
 
 
-def _train_step_time(model, X, y, iters=4):
+def _train_step_time(model, X, y, iters=4, n_pair=None):
     """Seconds/step of a compiled training model: on-device ``lax.scan`` over
     steps, slope between two scan lengths (the ~100ms tunnel sync and the
     per-call dispatch both cancel in the slope).  Scan lengths ADAPT to the
     step cost so the slope signal is ~0.25s — small fused steps are µs-scale
-    and a fixed length drowns in the tunnel's ms-scale sync jitter."""
+    and a fixed length drowns in the tunnel's ms-scale sync jitter.
+    ``n_pair=(n_lo, n_hi)`` skips the adaptive probe (2 fewer compiles) when
+    the caller knows the step's scale."""
     import functools
 
     import jax
@@ -295,11 +297,14 @@ def _train_step_time(model, X, y, iters=4):
             best = min(best, time.perf_counter() - t0)
         return best
 
-    # pre-estimate the step time from a rough slope (absolute times carry
-    # the ~100ms sync), then size the final slope for a ~0.35s signal
-    est = max((best_of(3000, k=2) - best_of(500, k=2)) / 2500, 2e-7)
-    n_hi = int(min(max(0.35 / est, 4000), 60000))
-    n_lo = max(n_hi // 10, 500)
+    if n_pair is not None:
+        n_lo, n_hi = n_pair
+    else:
+        # pre-estimate the step time from a rough slope (absolute times
+        # carry the ~100ms sync), then size the final slope for ~0.35s
+        est = max((best_of(3000, k=2) - best_of(500, k=2)) / 2500, 2e-7)
+        n_hi = int(min(max(0.35 / est, 4000), 60000))
+        n_lo = max(n_hi // 10, 500)
     return (best_of(n_hi) - best_of(n_lo)) / (n_hi - n_lo)
 
 
@@ -348,21 +353,24 @@ def bench_cost_model():
         return model, rng.randn(batch, seq, hidden).astype(np.float32), \
             rng.randint(0, 16, size=batch).astype(np.int32)
 
+    # (builder, fixed scan-length pair): known step scales skip the
+    # adaptive probe — 2 compiles per variant instead of 4, and the tunnel
+    # AOT compile is the dominant bench cost
     variants = {
-        "mlp_small": lambda: mlp(64, [512, 512]),
-        "mlp_wide": lambda: mlp(64, [2048, 2048]),
-        "mlp_deep": lambda: mlp(64, [512] * 6),
-        "mlp_batch": lambda: mlp(1024, [1024, 1024]),
-        "tfm_small": lambda: tfm(8, 64, 256, 8, 1024),
-        "tfm_wide": lambda: tfm(8, 128, 512, 8, 2048),
+        "mlp_small": (lambda: mlp(64, [512, 512]), (3000, 30000)),
+        "mlp_wide": (lambda: mlp(64, [2048, 2048]), (1500, 15000)),
+        "mlp_deep": (lambda: mlp(64, [512] * 6), (2000, 20000)),
+        "mlp_batch": (lambda: mlp(1024, [1024, 1024]), (400, 4000)),
+        "tfm_small": (lambda: tfm(8, 64, 256, 8, 1024), (500, 5000)),
+        "tfm_wide": (lambda: tfm(8, 128, 512, 8, 2048), (150, 1500)),
     }
     sim_ms, meas_ms = {}, {}
-    for name, build in variants.items():
+    for name, (build, n_pair) in variants.items():
         model, X, y = build()
         sim_ms[name] = simulate(
             model.plan, mm, training=True, measured=costs
         ).total * 1e3
-        meas_ms[name] = _train_step_time(model, X, y) * 1e3
+        meas_ms[name] = _train_step_time(model, X, y, n_pair=n_pair) * 1e3
         del model
 
     names = list(variants)

@@ -55,7 +55,7 @@ class DataLoader:
         # differs from the Python path for the same seed.
         self.native = native
         self._nb = None
-        self._nb_pos = 0
+        self._nb_gen = 0  # engine generation: advances the restart seed
 
     def __len__(self) -> int:
         return self.n // self.batch_size
@@ -78,6 +78,9 @@ class DataLoader:
                     f"{len(self.inputs)}"
                 )
             return None
+        if len(self) == 0:
+            # match the Python path's empty iteration (batch_size > n)
+            return None
         from . import native
 
         if not native.available():
@@ -85,25 +88,27 @@ class DataLoader:
                 raise RuntimeError("native dataloader requested but the "
                                    "library could not be built")
             return None
-        if self._nb is not None and self._nb_pos % len(self) != 0:
-            # a previous iteration stopped mid-epoch; the engine's stream
-            # is mid-permutation — restart it so every __iter__ delivers
-            # one clean epoch (each sample exactly once)
-            self._nb.close()
+        if self._nb is not None and self._nb.pos % len(self) != 0:
+            # a previous iteration stopped mid-epoch: abandon that engine
+            # (any live generator keeps its own captured reference; GC
+            # closes it) and start a fresh one with an ADVANCED seed so the
+            # restarted epoch is a new shuffle, not an epoch-0 replay
             self._nb = None
         if self._nb is None:
             (key, arr), = self.inputs.items()
             self._nkey = key
-            self._nb_pos = 0
+            self._nb_gen += 1
             self._nb = native.NativeBatcher(
                 arr, self.y, self.batch_size, shuffle=self.shuffle,
-                seed=self.seed, prefetch=self.prefetch,
+                seed=self.seed + self._nb_gen - 1, prefetch=self.prefetch,
             )
+
+        nb = self._nb  # captured: concurrent iterators keep their engine
 
         def gen():
             for _ in range(len(self)):
-                xb, yb, _ = self._nb.next()
-                self._nb_pos += 1
+                xb, yb, _ = nb.next()
+                nb.pos += 1
                 # own the data before the engine reuses its staging buffer
                 # (device_put can alias host memory on the CPU backend)
                 yield self._place({self._nkey: np.array(xb)}, np.array(yb))

@@ -30,13 +30,16 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _lib_failed:  # don't re-run make on every available() call
             return None
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                    capture_output=True, check=True, timeout=120,
-                )
-            except (OSError, subprocess.SubprocessError):
+        # run make unconditionally (a no-op when up to date) so edits to
+        # dataloader.cc never load a stale binary; treat failure as absent
+        # only when no library exists at all
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                capture_output=True, check=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            if not os.path.exists(_LIB_PATH):
                 _lib_failed = True
                 return None
         try:
@@ -92,6 +95,7 @@ class NativeBatcher:
             np.prod(self._x.shape[1:], dtype=np.int64) or 1)
         label_bytes = self._y.dtype.itemsize * int(
             np.prod(self._y.shape[1:], dtype=np.int64) or 1)
+        self.pos = 0  # batches consumed (epoch bookkeeping for DataLoader)
         self._h = lib.ffdl_create(
             self._x.ctypes.data_as(ctypes.c_void_p),
             self._y.ctypes.data_as(ctypes.c_void_p),

@@ -4,11 +4,12 @@
 // (src/loc/loader.cc + SingleDataLoader) — worker threads gather shuffled
 // batches into staging buffers so the accelerator never waits on host-side
 // indexing.  TPU-native shape: the Python DataLoader hands this engine a
-// pinned view of the (row-major) dataset; worker threads memcpy the
-// permuted rows for upcoming batches into a ring of staging buffers WITHOUT
-// holding the GIL, and the Python side wraps each ready buffer with
-// numpy/jax.device_put.  Python's own fancy-index gather both holds the GIL
-// and allocates per batch; this engine does neither on the hot path.
+// pinned view of the (row-major) dataset; the worker thread memcpys the
+// permuted rows for upcoming batches into a bounded queue of staging
+// buffers WITHOUT holding the GIL, and the Python side wraps each ready
+// buffer with numpy/jax.device_put.  Unlike Python's fancy-index gather,
+// the copy runs concurrently with training (no GIL); the per-batch buffer
+// allocation is malloc-cheap next to the row memcpys it stages.
 //
 // Plain C ABI (no pybind11 in this environment): driven via ctypes from
 // flexflow_tpu/data/native.py.  Build: `make -C flexflow_tpu/native`.
