@@ -97,10 +97,14 @@ class DataLoader:
         if self._nb is None:
             (key, arr), = self.inputs.items()
             self._nkey = key
+            # mix (seed, generation) so restart seeds never collide with a
+            # sibling loader's plain seed (seed+1 would)
+            gen_seed = (self.seed ^ (self._nb_gen * 0x9E3779B97F4A7C15)) \
+                & (2**64 - 1)
             self._nb_gen += 1
             self._nb = native.NativeBatcher(
                 arr, self.y, self.batch_size, shuffle=self.shuffle,
-                seed=self.seed + self._nb_gen - 1, prefetch=self.prefetch,
+                seed=gen_seed, prefetch=self.prefetch,
             )
 
         nb = self._nb  # captured: concurrent iterators keep their engine

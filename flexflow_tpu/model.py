@@ -577,9 +577,20 @@ class FFModel:
             shuffle: bool = True):
         assert self._train_step is not None, "call compile() first"
         from .utils.profiling import maybe_profile
+        from .utils.runlog import log_run
 
+        t0 = time.perf_counter()
         with maybe_profile(self.config.profiling):
-            return self._fit(x, y, epochs, batch_size, verbose, shuffle)
+            history = self._fit(x, y, epochs, batch_size, verbose, shuffle)
+        log_run("fit", {
+            "ops": len(self.graph.nodes),
+            "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
+            "strategy_ops": len(self.strategy or {}),
+            "epochs": len(history),
+            "final": history[-1] if history else None,
+            "seconds": round(time.perf_counter() - t0, 3),
+        })
+        return history
 
     def _fit(self, x, y, epochs, batch_size, verbose, shuffle):
         from .data import DataLoader

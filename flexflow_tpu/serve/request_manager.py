@@ -301,8 +301,22 @@ class RequestManager:
             self.register_new_request(p, max_new_tokens) for p in prompts
         ]
         from ..utils.profiling import maybe_profile
+        from ..utils.runlog import log_run
 
         profiling = bool(getattr(self.im.model.config, "profiling", False))
+        import time as _time
+
+        # snapshot the lifetime counters so the record is per-call deltas
+        tok0, step0, scan0 = self.tokens_decoded, self.steps, self.scan_runs
+        t0 = _time.perf_counter()
         with maybe_profile(profiling):
             out = self._serve()
+        log_run("serve", {
+            "manager": type(self).__name__,
+            "requests": len(rids),
+            "tokens": self.tokens_decoded - tok0,
+            "steps": self.steps - step0,
+            "scan_runs": self.scan_runs - scan0,
+            "seconds": round(_time.perf_counter() - t0, 3),
+        })
         return [out[rid] for rid in rids]

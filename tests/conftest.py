@@ -9,6 +9,7 @@ the real collectives on a virtual mesh.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets JAX_PLATFORMS=axon (TPU)
+os.environ["FLEXFLOW_TPU_RUN_LOG"] = ""  # no run-log pollution from tests
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
