@@ -65,6 +65,20 @@ class DataLoader:
         if self.plan is not None:
             from ..core.interpreter import place_inputs
 
+            # place_inputs looks up plan.input_shardings by graph input tid;
+            # loader keys (0, 1, ... or arbitrary dict keys) are only tids by
+            # accident — map positionally onto the plan's input tids (sorted
+            # tid order == declaration order) unless every key already IS one
+            known = self.plan.input_vids
+            if not all(k in known for k in arrs):
+                tids = sorted(known)
+                if len(arrs) != len(tids):
+                    raise ValueError(
+                        f"loader has {len(arrs)} inputs but the plan has "
+                        f"{len(tids)} graph inputs; positional mapping "
+                        "needs them to match (or use tid keys directly)"
+                    )
+                arrs = {t: v for t, v in zip(tids, arrs.values())}
             arrs = place_inputs(self.plan, arrs)
         return arrs, jnp.asarray(labels)
 

@@ -145,21 +145,29 @@ def propose_pipeline(
                                       s.out_shardings):
             out_sharding[tid_like] = (spec, sh)
     boundary = [0.0] * max(k - 1, 1)
+    # a skip connection spanning several stages traverses EVERY cut between
+    # producer and its furthest consumer in a GPipe schedule (intermediate
+    # stages forward it) — charge each crossed cut ONCE per tensor, not per
+    # consumer edge
+    far_stage: Dict[int, int] = {}
     for s, stg in zip(steps, stage_of_idx):
         for tid in s.node.inputs:
-            prod = graph.producer.get(tid)
-            if prod is None:
-                continue
-            src_stage = nid_stage.get(prod[0])
-            if src_stage is None or src_stage == stg:
-                continue
-            spec, sh = out_sharding.get(tid, (graph.spec(tid), None))
-            if sh is not None:
-                local = _local_size(spec, sh, mesh) * (
-                    spec.nbytes() // max(spec.size, 1))
-            else:
-                local = spec.nbytes()
-            boundary[min(src_stage, k - 2)] += local / n_micro
+            far_stage[tid] = max(far_stage.get(tid, 0), stg)
+    for tid, stg in far_stage.items():
+        prod = graph.producer.get(tid)
+        if prod is None:
+            continue
+        src_stage = nid_stage.get(prod[0])
+        if src_stage is None or src_stage >= stg:
+            continue
+        spec, sh = out_sharding.get(tid, (graph.spec(tid), None))
+        if sh is not None:
+            local = _local_size(spec, sh, mesh) * (
+                spec.nbytes() // max(spec.size, 1))
+        else:
+            local = spec.nbytes()
+        for cut in range(src_stage, stg):
+            boundary[cut] += local / n_micro
 
     stage_costs = [0.0] * k
     for t, stg in zip(times, stage_of_idx):
@@ -214,7 +222,8 @@ def pipeline_or_gspmd(
     try:
         gspmd = graph_optimize(graph, mesh, budget=budget, machine=mm,
                                measured=measured, seed=seed,
-                               training=training, memory_limit=memory_limit)
+                               training=training, memory_limit=memory_limit,
+                               on_infeasible="raise")
         cost_gspmd = simulate(
             PCG(graph, mesh, gspmd).plan(), mm, training=training,
             measured=measured,

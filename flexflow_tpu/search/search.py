@@ -85,6 +85,7 @@ def graph_optimize(
     output_tids: Optional[List[int]] = None,
     p_sub: float = 0.15,
     memory_limit: Optional[float] = None,
+    on_infeasible: str = "fallback",
 ):
     """Joint MCMC search over per-op parallel configs (+ graph rewrites).
 
@@ -143,6 +144,13 @@ def graph_optimize(
         cur_cost, cur_feas = cost_of(cur_graph, state)
     best = (cur_graph, dict(state), dict(tid_map))
     best_cost = cur_cost if cur_feas else float("inf")
+    # least-infeasible fallback: the memory estimate is deliberately high
+    # (4x params + the sum of ALL forward activations, ignoring XLA
+    # liveness/remat), so "nothing fits" may just mean the estimate is
+    # pessimistic — keep the lowest penalized cost seen so exhaustion can
+    # return it with a warning instead of hard-failing compile()
+    best_any = (cur_graph, dict(state), dict(tid_map))
+    best_any_cost = cur_cost
     if verbose:
         print(f"search: start cost {cur_cost * 1e3:.3f}ms, "
               f"{len(searchable)} searchable ops, budget {budget}")
@@ -199,6 +207,9 @@ def graph_optimize(
                 searchable, candidates = build_candidates(cur_graph)
                 cached_matches = None
                 accepted += 1
+                if cur_cost < best_any_cost:
+                    best_any = (cur_graph, dict(state), dict(tid_map))
+                    best_any_cost = cur_cost
                 if new_feas and cur_cost < best_cost:
                     best = (cur_graph, dict(state), dict(tid_map))
                     best_cost = cur_cost
@@ -229,6 +240,9 @@ def graph_optimize(
         ):
             state, cur_cost = proposal, new_cost
             accepted += 1
+            if cur_cost < best_any_cost:
+                best_any = (cur_graph, dict(state), dict(tid_map))
+                best_any_cost = cur_cost
             if new_feas and cur_cost < best_cost:
                 best = (cur_graph, dict(state), dict(tid_map))
                 best_cost = cur_cost
@@ -240,10 +254,22 @@ def graph_optimize(
         print(f"search: done, best {best_cost * 1e3:.3f}ms "
               f"({accepted}/{budget} accepted)")
     if math.isinf(best_cost):
-        raise ValueError(
+        if on_infeasible == "raise":
+            raise ValueError(
+                "graph_optimize found no strategy within the device memory "
+                f"limit ({mem_cap / 1e9:.2f}GB) in {budget} iterations"
+            )
+        import warnings
+
+        warnings.warn(
             "graph_optimize found no strategy within the device memory "
-            f"limit ({mem_cap / 1e9:.2f}GB) in {budget} iterations"
+            f"limit ({mem_cap / 1e9:.2f}GB) in {budget} iterations; "
+            "returning the least-infeasible strategy — the memory estimate "
+            "ignores XLA liveness/rematerialization, so the plan may still "
+            "run (set memory_limit=0 to disable the check)",
+            stacklevel=2,
         )
+        best = best_any
     if substitution:
         return best
     return best[1]

@@ -307,6 +307,21 @@ class InferenceManager:
         assert self.params is not None, "call init_operators_inference() first"
         import numpy as np
 
+        from .ops import DUS_MAX_TOKENS
+
+        if self.max_tokens > DUS_MAX_TOKENS:
+            # the scan's KV writes are padded to max_tokens; past the DUS
+            # threshold they become an XLA scatter whose layout choice
+            # forces a per-step full-cache relayout (see ops.DUS_MAX_TOKENS)
+            import warnings
+
+            warnings.warn(
+                f"decode_scan with max_tokens_per_batch {self.max_tokens} > "
+                f"{DUS_MAX_TOKENS}: KV writes take the scatter path and "
+                "re-lay out the full cache every step; use a smaller "
+                "max_tokens_per_batch for scanned decoding",
+                stacklevel=2,
+            )
         last = int(np.max(np.asarray(bc.token_position))) + n_steps
         if last > self.max_seq_len:
             raise ValueError(

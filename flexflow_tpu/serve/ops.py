@@ -46,11 +46,12 @@ from .batch_config import (
 NEG_INF = -1e30
 
 # token-count cutoff between the per-token dynamic-update-slice chain and a
-# single XLA scatter for KV-cache writes (see _scatter_rows_pos); decode
-# batches (<= max_requests) and spec commit descriptors
-# (<= max_requests * (depth+1)) must stay under it or they silently take
-# the scatter path, whose layout choice forces a per-step full-cache
-# relayout inside the decode/spec scans — SpecDecodeScan checks at init.
+# single XLA scatter for KV-cache writes (see _scatter_rows_pos).  The
+# switch is on the CAPACITY-PADDED batch length (max_tokens_per_batch),
+# not the live token count: any InferenceManager whose max_tokens exceeds
+# this silently takes the scatter path, whose layout choice forces a
+# per-step full-cache relayout inside the decode/spec scans —
+# SpecDecodeScan and InferenceManager.decode_scan check their capacities.
 DUS_MAX_TOKENS = 128
 
 

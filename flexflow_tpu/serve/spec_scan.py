@@ -94,13 +94,23 @@ class SpecDecodeScan:
             raise ValueError(f"SSM needs topk >= width ({self.width})")
         from .ops import DUS_MAX_TOKENS
 
-        if R * (self.depth + 1) > DUS_MAX_TOKENS:
-            raise ValueError(
-                f"commit descriptor ({R}x{self.depth + 1} entries) exceeds "
-                f"the KV-write DUS threshold ({DUS_MAX_TOKENS}); the scatter "
-                "fallback forces a per-macro-step full-cache relayout — use "
-                "fewer request slots or a shallower tree"
-            )
+        # _scatter_rows_pos switches paths on the CAPACITY-PADDED array
+        # length, not the live token count: inside the jitted macro step the
+        # commit descriptor and verify-step KV writes are padded to
+        # llm.max_tokens and the catch-up/draft batches to ssm.max_tokens,
+        # so those capacities are what must stay under the DUS threshold —
+        # a guard on R*(depth+1) alone would pass while the padded arrays
+        # silently took the scatter path (per-macro-step full-cache relayout).
+        for tag, cap_t in (("llm", llm.max_tokens), ("ssm", ssm.max_tokens)):
+            if cap_t > DUS_MAX_TOKENS:
+                raise ValueError(
+                    f"{tag} max_tokens_per_batch ({cap_t}) exceeds the "
+                    f"KV-write DUS threshold ({DUS_MAX_TOKENS}); every "
+                    "KV write inside the macro-step scan is padded to that "
+                    "capacity, so the scatter fallback would force a "
+                    "per-macro-step full-cache relayout — use fewer request "
+                    "slots or a shallower/narrower tree"
+                )
         # the verify batch always ships exactly n_tree tokens per request in
         # slot-major order -> the LLM can use the batched tree kernel (the
         # committed cache streams once per request, not once per tree token).

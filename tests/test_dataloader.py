@@ -52,6 +52,36 @@ def test_native_engine_matches_python_semantics():
     dl._nb.close()
 
 
+def test_loader_plan_placement_maps_keys_to_tids():
+    """Loader keys (0, 1, ...) are mapped onto the plan's input tids even
+    when the graph's input tids are not 0..n-1 (ADVICE r3: placement was
+    silently skipped whenever keys != tids)."""
+    mesh = make_mesh({"dp": 4}, jax.devices()[:4])
+    model = FFModel(FFConfig(batch_size=16), mesh=mesh)
+    x1 = model.create_tensor((16, 8))
+    h1 = model.dense(x1, 8, activation="relu")  # creates non-input tensors
+    x2 = model.create_tensor((16, 8))           # input tid is NOT 1
+    h = model.add(h1, x2)
+    model.softmax(model.dense(h, 4))
+    model.compile(optimizer=SGDOptimizer(lr=0.1))
+    tids = model.graph.input_tids
+    assert tids != list(range(len(tids))), "test premise: tids not 0..n-1"
+
+    X1 = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+    X2 = np.random.RandomState(1).randn(32, 8).astype(np.float32)
+    y = np.zeros(32, np.int32)
+    dl = DataLoader([X1, X2], y, batch_size=16, shuffle=False,
+                    plan=model.plan)
+    for arrs, _ in dl:
+        assert set(arrs) == set(tids)
+        for t in tids:
+            sh = model.plan.input_shardings.get(t)
+            if sh is None:
+                continue
+            want = sh.named_sharding(mesh)
+            assert arrs[t].sharding.is_equivalent_to(want, arrs[t].ndim)
+
+
 def test_fit_with_loader_trains():
     mesh = make_mesh({"dp": 4}, jax.devices()[:4])
     model = FFModel(FFConfig(batch_size=16, learning_rate=0.1), mesh=mesh)

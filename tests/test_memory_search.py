@@ -65,10 +65,20 @@ def test_search_rejects_infeasible_best():
     assert capped != free
 
 
-def test_search_raises_when_nothing_fits():
+def test_search_falls_back_when_nothing_fits():
+    """The deliberately-high memory estimate must not hard-fail compile:
+    exhaustion returns the least-infeasible strategy with a warning
+    (ADVICE r3), while on_infeasible='raise' keeps the old contract for
+    callers that need to detect infeasibility (pipeline_or_gspmd)."""
     mesh = make_mesh({"dp": 2}, jax.devices()[:2])
     model = big_mlp(mesh)
     mm = MachineModel.for_mesh(mesh, spec_name="v5e")
+    with pytest.warns(UserWarning, match="least-infeasible"):
+        strat = graph_optimize(model.graph, mesh, budget=30, machine=mm,
+                               seed=0, memory_limit=1024)  # 1KB: nothing fits
+    # the fallback strategy must still plan (it is runnable, just over the
+    # pessimistic estimate)
+    PCG(model.graph, mesh, strat).plan()
     with pytest.raises(ValueError, match="memory"):
         graph_optimize(model.graph, mesh, budget=30, machine=mm, seed=0,
-                       memory_limit=1024)  # 1KB: nothing fits
+                       memory_limit=1024, on_infeasible="raise")
