@@ -278,6 +278,13 @@ class FFModel:
         return self._add(Aggregate(), [expert_out, combine],
                          name or "aggregate")[0]
 
+    def aggregate_spec(self, expert_out, combine, gates, k=1, name=None):
+        """Un-weighted per-choice expert outputs [N, k, d] (aggregate_spec.cu)."""
+        from .ops.moe import AggregateSpec
+
+        return self._add(AggregateSpec(k), [expert_out, combine, gates],
+                         name or "aggregate_spec")[0]
+
     def moe_layer(self, x, num_experts, out_dim, hidden_dim=None, k=1,
                   capacity_factor=1.25, activation="relu", name=None):
         """Router (dense+softmax) -> group_by -> experts -> aggregate."""
@@ -290,6 +297,14 @@ class FFModel:
         eo = self.experts(disp, out_dim, hidden_dim, activation,
                           name=f"{name}.experts")
         return self.aggregate(eo, comb, name=f"{name}.aggregate")
+
+    def cache(self, x, name=None):
+        """Activation cache (reference ``src/ops/cache.cc``): identity in
+        refresh steps; with ``extras['cache_use']`` the stored value replays
+        (state threaded like the serve KV caches)."""
+        from .ops.misc import Cache
+
+        return self._add(Cache(), [x], name or "cache")[0]
 
     # attention (serving): KV-cached / speculative / tree-verify variants.
     # Reference: FFModel::inc_multihead_self_attention and friends in

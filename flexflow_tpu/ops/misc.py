@@ -1,0 +1,67 @@
+"""Misc ops: Cache (activation reuse across steps).
+
+Reference: ``src/ops/cache.cc`` — stores an intermediate tensor across
+batches so later iterations can reuse it instead of recomputing (the
+reference uses it for static features, e.g. DLRM embedding outputs whose
+inputs repeat).  TPU re-design: the cached value is FUNCTIONAL STATE
+threaded through the jitted step exactly like the serve KV caches
+(``core/interpreter.py`` stateful-op support) — no mutable OpMeta.  The
+mode is a static flag per compiled program (``extras["cache_use"]``):
+refresh mode recomputes and publishes the new value, use mode returns the
+stored one; XLA compiles each exactly once.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.graph import TensorSpec
+from ..core.op import Op, ShardingSolution, register_op
+from ..core.sharding import TensorSharding
+
+
+@register_op
+class Cache(Op):
+    """Identity that can replay its previously-stored input.
+
+    State: ``{"cached": <last refreshed value>}``.  With
+    ``extras["cache_use"]`` set (static), returns the stored value and
+    leaves state untouched; otherwise passes the input through and stores
+    it.  Running in use mode without prior state is an error (the reference
+    likewise triggers a refresh batch first).
+    """
+
+    type_name = "cache"
+    stateful = True
+
+    def infer_shapes(self, in_specs):
+        x = in_specs[0]
+        return [TensorSpec(x.shape, x.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        x = inputs[0]
+        state = ctx.extras.get("state")
+        if ctx.extras.get("cache_use"):
+            if not state or "cached" not in state:
+                raise ValueError(
+                    "cache op in use mode without a stored value — run a "
+                    "refresh step (no cache_use flag) first"
+                )
+            ctx.extras["state_out"] = state
+            return [state["cached"].astype(x.dtype)]
+        ctx.extras["state_out"] = {"cached": x}
+        return [x]
+
+    def parallel_dims(self, in_specs):
+        return {"sample": in_specs[0].shape[0]}
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        nd = len(in_specs[0].shape)
+        sh = TensorSharding.replicated(nd)
+        sample = tuple(config.get("sample", ()))
+        if sample:
+            sh = sh.with_dim(0, sample)
+        return ShardingSolution(inputs=[sh], outputs=[sh])
+
+    def flops(self, in_specs):
+        return 0

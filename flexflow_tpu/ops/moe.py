@@ -14,8 +14,11 @@ dispatch** (GShard/Mixtral style) so every shape is static:
   ``expert`` mesh axes, and with tokens sample-sharded GSPMD lowers the
   dispatch/combine einsums to the ``all_to_all`` over ICI.
 * :class:`Aggregate` — combine expert outputs back to token order, weighted
-  by gate probabilities.  (``AggregateSpec``'s speculative variant is
-  subsumed by the serve tree machinery and not needed here.)
+  by gate probabilities.
+* :class:`AggregateSpec` — the un-weighted per-choice variant
+  (``aggregate_spec.cu``): each token's k selected experts' raw outputs,
+  ``[N, k, d]`` (the reference emits the same rows stacked ``[k*N, d]``),
+  for specialization losses that need to see each expert's own prediction.
 """
 
 from __future__ import annotations
@@ -238,4 +241,60 @@ class Aggregate(Op):
 
     def flops(self, in_specs):
         eo, comb = in_specs
+        return 2 * int(np.prod(comb.shape)) * eo.shape[-1]
+
+
+@register_op
+class AggregateSpec(Op):
+    """(expert_out [E, C, d], combine [N, E, C], gates [N, E]) -> [N, k, d].
+
+    Reference: ``src/ops/aggregate_spec.cu`` — returns each token's k
+    selected experts' outputs UN-weighted (stacked ``[k*N, d]`` there;
+    ``[N, k, d]`` here), so a specialization/load-balancing loss can grade
+    every expert's own prediction.  The k-ranking is recomputed from
+    ``gates`` with the same ``top_k`` as :class:`GroupBy` (deterministic
+    ties), and the token's capacity slot comes from ``combine``'s dispatch
+    pattern — dropped (over-capacity) tokens yield zero rows, matching the
+    fixed-capacity dispatch design.
+    """
+
+    type_name = "aggregate_spec"
+
+    def __init__(self, k: int = 1):
+        self.k = int(k)
+
+    def infer_shapes(self, in_specs):
+        eo, comb, gates = in_specs
+        return [TensorSpec((comb.shape[0], self.k, eo.shape[-1]), eo.dtype)]
+
+    def lower(self, ctx, inputs, params):
+        eo, comb, gates = inputs
+        e = eo.shape[0]
+        _, topi = jax.lax.top_k(gates, self.k)              # [N, k]
+        sel = jax.nn.one_hot(topi, e, dtype=jnp.float32)    # [N, k, E]
+        disp = (comb > 0).astype(jnp.float32)               # [N, E, C]
+        out = jnp.einsum("nke,nec,ecd->nkd", sel, disp,
+                         eo.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return [out.astype(eo.dtype)]
+
+    def parallel_dims(self, in_specs):
+        return {"expert": in_specs[0].shape[0]}
+
+    def apply_config(self, config, in_specs, mesh, in_shardings=None):
+        expert = tuple(config.get("expert", ()))
+        eo_sh = TensorSharding.replicated(3)
+        comb_sh = TensorSharding.replicated(3)
+        g_sh = TensorSharding.replicated(2)
+        out_sh = TensorSharding.replicated(3)
+        if expert:
+            eo_sh = eo_sh.with_dim(0, expert)
+            comb_sh = comb_sh.with_dim(1, expert)
+            g_sh = g_sh.with_dim(1, expert)
+            out_sh = out_sh.with_partial(expert)
+        return ShardingSolution(inputs=[eo_sh, comb_sh, g_sh],
+                                outputs=[out_sh])
+
+    def flops(self, in_specs):
+        eo, comb, _ = in_specs
         return 2 * int(np.prod(comb.shape)) * eo.shape[-1]

@@ -206,6 +206,172 @@ def decode_attention(
     return out.reshape(t, qh, d)
 
 
+# Prefill streams K+V blocks against a Bq*gq-row query tile; the block
+# budget is tighter than decode's because the scores tile [KV, Bq*gq, Bs]
+# and the q/o/acc tiles also live in VMEM.
+_VMEM_BUDGET_PREFILL = 4 * 2**20
+
+
+def _prefill_kernel(
+    rows_ref,       # scalar prefetch: i32[G] cache row per tile
+    pstart_ref,     # scalar prefetch: i32[G] first position in tile
+    fmax_ref,       # scalar prefetch: i32[G] causal frontier (last position)
+    q_ref,          # [1, KV, M, D] tile queries, M = Bq*gq (b-major fold)
+    k_ref,          # [1, KV, Bs, D] cache K block (row rows[g], block s)
+    v_ref,          # [1, KV, Bs, D]
+    o_ref,          # [1, KV, M, D]
+    m_ref,          # VMEM scratch [KV, M, 128]
+    l_ref,          # VMEM scratch [KV, M, 128]
+    acc_ref,        # VMEM scratch [KV, M, D]
+    *,
+    block_s: int,
+    num_kv: int,
+    gq: int,
+    m_rows: int,
+    scale: float,
+):
+    g = pl.program_id(0)
+    s = pl.program_id(1)
+    last_s = pl.num_programs(1) - 1
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    fmax = fmax_ref[g]
+    pstart = pstart_ref[g]
+    base = s * block_s
+
+    @pl.when(base <= fmax)  # blocks past the frontier: DMA already clamped
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)               # [KV, M, D]
+        k = k_ref[0].astype(jnp.float32)               # [KV, Bs, D]
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                       # [KV, M, Bs]
+
+        # per-row causal mask, reconstructed from the tile's start position:
+        # query row r (= b*gq + g') sits at absolute position pstart + b
+        qpos = pstart + jax.lax.broadcasted_iota(
+            jnp.int32, (m_rows, block_s), 0
+        ) // gq
+        key_pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (m_rows, block_s), 1
+        )
+        live = jnp.broadcast_to((key_pos <= qpos)[None], sc.shape)
+        sc = jnp.where(live, sc, NEG_INF)
+
+        m_prev = m_ref[:, :, 0:1]                       # [KV, M, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(live, jnp.exp(sc - m_new), 0.0)
+        l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)                # [KV, Bs, D]
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                               # [KV, M, D]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s == last_s)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_s", "interpret")
+)
+def prefill_attention(
+    q: jax.Array,        # [G, Bq, QH, D] tile queries (RoPE applied)
+    k_cache: jax.Array,  # [R+1, KV, S, D] (this step's KV already written)
+    v_cache: jax.Array,  # [R+1, KV, S, D]
+    rows: jax.Array,     # i32[G] cache row per tile
+    pstart: jax.Array,   # i32[G] first token position per tile
+    scale: float,
+    block_s: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Q-tiled prefill attention (the prompt phase of the reference's IncMHA).
+
+    One grid row per TILE of Bq same-request tokens with contiguous
+    positions (PrefillBatchConfig's contract): the committed-prefix blocks
+    stream ONCE per tile instead of once per token — a Bq-fold cut in HBM
+    traffic vs routing prefill through :func:`decode_attention` — and the
+    score/value contractions carry Bq*gq query rows, real MXU tiles instead
+    of decode's single-row vector products.  Same online-softmax core and
+    causal DMA clamp as decode; tiles fold into the query-group dim exactly
+    like :func:`tree_attention_batched`.  ALiBi models use the gather
+    fallback (serve/ops.py routes them there).
+    """
+    g, bq, qh, d = q.shape
+    _, num_kv, s_len, _ = k_cache.shape
+    gq = qh // num_kv
+    m_rows = bq * gq
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    while (block_s > 128
+           and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET_PREFILL):
+        block_s //= 2
+    block_s = min(block_s, s_len)
+    if s_len % block_s:  # see decode_attention: tail blocks alias positions
+        block_s = math.gcd(block_s, s_len)
+    n_blocks = s_len // block_s
+    # fold tiles into the query-group dim, b-major: row = b*gq + g'
+    qr = q.reshape(g, bq, num_kv, gq, d).transpose(0, 2, 1, 3, 4) \
+         .reshape(g, num_kv, m_rows, d)
+    fmax = jnp.clip(pstart + bq - 1, 0, s_len - 1)
+
+    def kv_map(i, j, rows, pstart, fmax):
+        return (rows[i], 0, jnp.minimum(j, fmax[i] // block_s), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(g, n_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, num_kv, m_rows, d),
+                lambda i, j, rows, pstart, fmax: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_kv, m_rows, d),
+            lambda i, j, rows, pstart, fmax: (i, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, m_rows, 128), jnp.float32),
+            pltpu.VMEM((num_kv, m_rows, 128), jnp.float32),
+            pltpu.VMEM((num_kv, m_rows, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        block_s=block_s, num_kv=num_kv, gq=gq, m_rows=m_rows,
+        scale=float(scale),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((g, num_kv, m_rows, d), q.dtype),
+        interpret=interpret,
+    )(rows.astype(jnp.int32), pstart.astype(jnp.int32), fmax,
+      qr, k_cache, v_cache)
+    return out.reshape(g, num_kv, bq, gq, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(g, bq, qh, d)
+
+
 def _tree_kernel(
     rows_ref,       # scalar prefetch: i32[T] cache row per token
     clens_ref,      # scalar prefetch: i32[T] committed cache depth per token

@@ -93,6 +93,11 @@ def graph_optimize(
     ``(graph, strategy, tid_map)`` where ``tid_map`` maps original tensor
     ids to the rewritten graph's (identity when no rewrite was accepted).
     """
+    if on_infeasible not in ("fallback", "raise"):
+        raise ValueError(
+            f"on_infeasible must be 'fallback' or 'raise', got "
+            f"{on_infeasible!r}"
+        )
     rng = random.Random(seed)
     mm = machine or MachineModel.for_mesh(mesh)
 

@@ -7,6 +7,7 @@ batch_config}.cc`` + ``inference/models/*`` + ``python/flexflow/serve``.
 from .batch_config import (
     BatchConfig,
     InferenceResult,
+    PrefillBatchConfig,
     TreeSearchBatchConfig,
     TreeVerifyBatchConfig,
     MAX_NUM_REQUESTS,
@@ -34,6 +35,7 @@ from . import models  # noqa: F401  (registers model builders)
 
 __all__ = [
     "BatchConfig",
+    "PrefillBatchConfig",
     "TreeSearchBatchConfig",
     "TreeVerifyBatchConfig",
     "InferenceResult",

@@ -115,6 +115,90 @@ class BatchConfig:
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
+class PrefillBatchConfig:
+    """A prompt-prefill step whose flat tokens are grouped into request-
+    homogeneous tiles, unlocking the Q-tiled Pallas prefill kernel.
+
+    The reference's IncMHA CUDA kernel serves prompt and decode phases with
+    one code path (``inc_multihead_self_attention.cu``); on TPU the two
+    phases want different grids — decode is one query per cache row
+    (bandwidth-bound), prefill is a *block* of queries per cache row
+    (MXU-bound) — so prefill ships this wrapper type and the attention op
+    mode-dispatches on it like the tree variants.
+
+    Contract (enforced by :meth:`build`): with ``Bq = tile_size`` and
+    ``G = base.max_tokens // Bq``, flat slot ``g*Bq + b`` belongs to tile
+    ``g``; each tile's real tokens (a) belong to ONE request, (b) sit at the
+    tile's head with pad slots only at the tail, and (c) have contiguous
+    ascending positions.  The kernel then reconstructs every per-token causal
+    mask from the tile's first position alone.
+    """
+
+    base: BatchConfig
+    tile_size: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def num_tiles(self) -> int:
+        return self.base.max_tokens // self.tile_size
+
+    @staticmethod
+    def build(
+        segments,
+        seq_lens,
+        tile_size: int,
+        max_tokens: int = MAX_NUM_TOKENS,
+        max_requests: int = MAX_NUM_REQUESTS,
+    ):
+        """Tile-aligned constructor.
+
+        ``segments``: iterable of ``(slot, token_ids, start_pos)`` — one
+        contiguous prompt chunk per request.  Returns ``(pbc, last_flat)``
+        where ``last_flat[slot]`` is the flat index of that segment's final
+        token (where its first-generated-token logits appear).
+        """
+        fields, last_flat = PrefillBatchConfig.np_fields(
+            segments, seq_lens, tile_size, max_tokens, max_requests
+        )
+        base = BatchConfig(*(jnp.asarray(f) for f in fields))
+        return PrefillBatchConfig(base=base, tile_size=tile_size), last_flat
+
+    @staticmethod
+    def np_fields(segments, seq_lens, tile_size, max_tokens, max_requests):
+        """:meth:`build`'s host-side half: the five BatchConfig fields as
+        numpy arrays (field order) — callers that stack many chunks (the
+        RequestManager's prefill stretch) stack these and transfer once,
+        instead of shipping five tiny arrays to the device per chunk."""
+        if max_tokens % tile_size:
+            raise ValueError(
+                f"tile_size {tile_size} must divide max_tokens {max_tokens}"
+            )
+        tokens = np.zeros(max_tokens, np.int32)
+        req = np.full(max_tokens, -1, np.int32)
+        pos = np.zeros(max_tokens, np.int32)
+        last_flat = {}
+        at = 0
+        n = 0
+        for slot, toks, start in segments:
+            need = -(-len(toks) // tile_size) * tile_size  # round up to tiles
+            if at + need > max_tokens:
+                raise ValueError(
+                    f"segments need {at + need} padded slots > capacity "
+                    f"{max_tokens}"
+                )
+            tokens[at: at + len(toks)] = toks
+            req[at: at + len(toks)] = slot
+            pos[at: at + len(toks)] = np.arange(start, start + len(toks))
+            last_flat[slot] = at + len(toks) - 1
+            n = at + len(toks)
+            at += need
+        sl = np.zeros(max_requests, np.int32)
+        sl[: len(seq_lens)] = seq_lens
+        fields = (tokens, req, pos, np.asarray(n, np.int32), sl)
+        return fields, last_flat
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
 class TreeSearchBatchConfig:
     """Draft-model (SSM) tree-expansion step.
 

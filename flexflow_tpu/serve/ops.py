@@ -39,6 +39,7 @@ from ..core.op import Op, OpContext, ShardingSolution, bias_once, register_op
 from ..core.sharding import TensorSharding
 from .batch_config import (
     BatchConfig,
+    PrefillBatchConfig,
     TreeSearchBatchConfig,
     TreeVerifyBatchConfig,
 )
@@ -230,6 +231,8 @@ class IncMultiHeadSelfAttention(Op):
             out, state = self._tree_attend(q, k, v, state, bc, ctx)
         elif isinstance(bc, TreeSearchBatchConfig):
             out, state = self._tree_attend(q, k, v, state, bc, ctx)
+        elif isinstance(bc, PrefillBatchConfig):
+            out, state = self._prefill_attend(q, k, v, state, bc, ctx)
         else:
             out, state = self._inc_attend(q, k, v, state, bc, ctx)
 
@@ -437,6 +440,68 @@ class IncMultiHeadSelfAttention(Op):
         )
         t = q.shape[0]
         out = out.reshape(t, self.num_q_heads, self.head_dim).astype(q.dtype)
+        new_state = dict(state)
+        new_state["k"], new_state["v"] = kc, vc
+        return out, new_state
+
+    def _prefill_attend(self, q, k, v, state, bc: PrefillBatchConfig, ctx):
+        """Prompt-phase attention over request-homogeneous query tiles.
+
+        Routes to the Q-tiled Pallas prefill kernel (prefix blocks stream
+        once per TILE, not once per token — see
+        ``ops/pallas/attention.py:prefill_attention``); falls back to the
+        flat gather path (``_inc_attend``) for ALiBi models or shardings
+        the kernel can't express — the fallback is also the equality oracle
+        the prefill tests compare against.
+        """
+        base = bc.base
+        use_kernel = (
+            ctx is not None
+            and ctx.extras.get("pallas_decode")
+            and not self.use_alibi
+        )
+        if not use_kernel:
+            return self._inc_attend(q, k, v, state, base, ctx)
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.pallas.attention import prefill_attention
+
+        kc, vc = state["k"], state["v"]
+        nreq = kc.shape[0] - 1
+        rows = self._rows(base, nreq)
+        pos = base.token_position
+        kc = self._scatter_rows_pos(kc, rows, pos, k)
+        vc = self._scatter_rows_pos(vc, rows, pos, v)
+
+        t = q.shape[0]
+        bq = bc.tile_size
+        g = t // bq
+        interp = bool(ctx.extras.get("pallas_interpret"))
+        # tile row: real slots sit at the tile head, pads map to the scratch
+        # row nreq (the largest index), so min() recovers the tile's request
+        tile_rows = jnp.min(rows.reshape(g, bq), axis=1)
+        pstart = pos.reshape(g, bq)[:, 0]
+
+        def attend(q_, kc_, vc_, rows_, pstart_):
+            kv_l, gq = q_.shape[1], q_.shape[2]
+            return prefill_attention(
+                q_.reshape(t, kv_l * gq, self.head_dim).reshape(
+                    g, bq, kv_l * gq, self.head_dim
+                ),
+                kc_, vc_, rows_, pstart_,
+                scale=self.scaling_factor, interpret=interp,
+            ).reshape(t, kv_l, gq, self.head_dim)
+
+        h = self._config_head_axes(ctx)
+        sm = self._head_shard_map(
+            ctx, h,
+            [P(None, h), P(None, h), P(None, h), P(), P()],
+            P(None, h),
+        )
+        if sm is None:  # unsupported sharding: flat gather fallback
+            return self._inc_attend(q, k, v, state, base, ctx)
+        out = sm(attend)(q, kc, vc, tile_rows, pstart)
+        out = out.reshape(t, self.num_q_heads, self.head_dim)
         new_state = dict(state)
         new_state["k"], new_state["v"] = kc, vc
         return out, new_state

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .batch_config import BatchConfig
+from .batch_config import BatchConfig, PrefillBatchConfig
 
 
 class RequestStatus(enum.Enum):
@@ -51,9 +51,11 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     stop_on_eos: bool = True
     # sampling (reference: GenerationConfig in flexflow/inference.py + the
-    # Sampling op).  temperature <= 0 -> exact greedy argmax.  Sampling is
-    # incremental-decoding only; speculative serving stays greedy (the
-    # accept walk's equality test requires deterministic targets).
+    # Sampling op).  temperature <= 0 -> exact greedy argmax.  Speculative
+    # serving supports it too: the verify step samples per tree node and the
+    # accept walk matches drafts against the sampled tokens (spec_infer
+    # ._verify_phase / spec_scan._macro_body), preserving the target
+    # sampling distribution for any draft model.
     temperature: float = 0.0
     top_p: float = 1.0
     seed: int = 0
@@ -160,6 +162,41 @@ class RequestManager:
                 sample_points.append((len(tokens) - 1, req.rid))
                 budget -= 1
 
+        # a pure-prefill step with Pallas enabled ships tile-aligned chunks
+        # (PrefillBatchConfig -> the Q-tiled prefill kernel); mixed
+        # decode+prefill steps keep the flat layout
+        tile = getattr(self.im, "prefill_tile", 1)
+        if (not tokens and tile > 1 and self.im.use_pallas
+                and any(r.status is RequestStatus.PREFILLING
+                        for r in self._active())):
+            segments = []
+            for req in self._active():
+                if req.status is not RequestStatus.PREFILLING or budget < tile:
+                    continue
+                # cap at whole tiles so the padded segment fits the capacity
+                take = min((budget // tile) * tile,
+                           len(req.prompt) - req.prefill_offset)
+                start = req.prefill_offset
+                segments.append(
+                    (req.slot, req.prompt[start: start + take], start)
+                )
+                req.prefill_offset += take
+                budget -= -(-take // tile) * tile  # padded tiles consumed
+                if req.prefill_offset == len(req.prompt):
+                    sample_points.append((req.slot, req.rid))
+            seq_lens = np.zeros(self.im.max_requests, np.int32)
+            for req in self._active():
+                seq_lens[req.slot] = req.prefill_offset + len(req.generated)
+            pbc, last_flat = PrefillBatchConfig.build(
+                segments, seq_lens, tile,
+                max_tokens=self.im.max_tokens,
+                max_requests=self.im.max_requests,
+            )
+            sample_points = [
+                (last_flat[slot], rid) for slot, rid in sample_points
+            ]
+            return pbc, sample_points
+
         # then prefill chunks fill the remaining budget
         for req in self._active():
             if req.status is not RequestStatus.PREFILLING or budget <= 0:
@@ -190,6 +227,10 @@ class RequestManager:
         return bc, sample_points
 
     def process_result(self, result, sample_points) -> None:
+        if not sample_points:
+            # mid-prefill step: nothing to read back — leave the result on
+            # device so chunked prefill dispatches stay fully async
+            return
         token_ids = np.asarray(result.token_ids)
         for flat_idx, rid in sample_points:
             req = self.requests[rid]
@@ -237,6 +278,87 @@ class RequestManager:
 
     scan_chunk = 32  # sync-amortization window for the decode scan
 
+    # ------------------------------------------------------------------
+    def _prefill_stretch_possible(self) -> bool:
+        """Can the whole current prefill wave run as on-device scans?
+
+        True when every active request is PREFILLING (no decode latency to
+        protect) and the InferenceManager has the tiled-prefill path.  The
+        stretch then feeds every request's remaining prompt through
+        ``prefill_scan`` — one dispatch per power-of-two chunk segment and
+        ONE host sync at the end, vs a dispatch per chunk (+ a ~100ms tunnel
+        sync per request boundary) on the per-step path.
+        """
+        self._admit()
+        active = self._active()
+        tile = getattr(self.im, "prefill_tile", 1)
+        return (
+            tile > 1
+            and self.im.use_pallas
+            and hasattr(self.im, "prefill_scan")
+            and bool(active)
+            and all(r.status is RequestStatus.PREFILLING for r in active)
+            and any(r.prefill_offset < len(r.prompt) for r in active)
+        )
+
+    def _prefill_stretch(self) -> None:
+        """Prefill every active request's remaining prompt via prefill_scan."""
+        import jax
+        import jax.numpy as jnp
+
+        im = self.im
+        tile = im.prefill_tile
+        cap = im.max_tokens
+        chunks: List = []  # per-chunk numpy field tuples (BatchConfig order)
+        points: List[Tuple[int, int, int]] = []  # (chunk_idx, flat_idx, rid)
+        seq = np.zeros(im.max_requests, np.int32)
+        for req in self._active():
+            seq[req.slot] = req.prefill_offset + len(req.generated)
+        for req in self._active():
+            if req.status is not RequestStatus.PREFILLING:
+                continue
+            while req.prefill_offset < len(req.prompt):
+                take = min((cap // tile) * tile,
+                           len(req.prompt) - req.prefill_offset)
+                start = req.prefill_offset
+                seq[req.slot] = start + take
+                fields, last_flat = PrefillBatchConfig.np_fields(
+                    [(req.slot, req.prompt[start: start + take], start)],
+                    seq, tile,
+                    max_tokens=cap, max_requests=im.max_requests,
+                )
+                req.prefill_offset += take
+                if req.prefill_offset == len(req.prompt):
+                    points.append((len(chunks), last_flat[req.slot], req.rid))
+                chunks.append(fields)
+        # stack chunk fields host-side (ONE device transfer per field per
+        # segment, not five tiny transfers per chunk) and scan in power-of-
+        # two segments so each distinct scan length compiles at most once
+        outs = []   # (start_chunk, token array [seg, cap]) — read after all
+        at = 0
+        while at < len(chunks):
+            seg = 1 << (min(len(chunks) - at, 64).bit_length() - 1)
+            stacked = PrefillBatchConfig(
+                base=BatchConfig(*(
+                    jnp.asarray(np.stack([c[i] for c in chunks[at: at + seg]]))
+                    for i in range(5)
+                )),
+                tile_size=tile,
+            )
+            outs.append((at, im.prefill_scan(stacked, self._sample_arg())))
+            at += seg
+        toks = {start: np.asarray(t) for start, t in outs}  # one sync
+        starts = sorted(toks)
+        for chunk_idx, flat_idx, rid in points:
+            start = max(s for s in starts if s <= chunk_idx)
+            req = self.requests[rid]
+            req.status = RequestStatus.DECODING
+            req.generated.append(int(toks[start][chunk_idx - start, flat_idx]))
+            self.tokens_decoded += 1
+            self._maybe_finish(req)
+        self.steps += len(chunks)
+        self.scan_runs += 1
+
     def _decode_stretch(self, n: int) -> None:
         """Run n decode steps on device with one host sync (decode_scan)."""
         active = self._active()
@@ -280,6 +402,9 @@ class RequestManager:
         the per-step host path only handles admission/prefill boundaries.
         """
         while self.has_work():
+            if self._prefill_stretch_possible():
+                self._prefill_stretch()
+                continue
             n = self._scan_steps_possible()
             if n > 1:
                 self._decode_stretch(n)

@@ -145,7 +145,9 @@ class SpecInferManager(RequestManager):
             if not toks:
                 break
             bc = self._plain_bc(self.llm, toks, reqi, pos)
-            result = self.llm.step(bc)
+            # sample arg so the first generated token (read off the last
+            # prompt position's logits) honors temperature/top_p
+            result = self.llm.step(bc, sample=self._sample_arg())
             self.llm_steps += 1
             ids = np.asarray(result.token_ids)
             for flat, rid in points:
@@ -323,12 +325,19 @@ class SpecInferManager(RequestManager):
             TreeVerifyBatchConfig, self.llm, toks, reqi, pos, spec, masks,
             committed_attr="llm_committed", commit=commit,
         )
-        result = self.llm.step(bc)
+        # stochastic verification: with temperature > 0 the verify step
+        # SAMPLES y ~ p(target | node prefix) per tree node (seeded, top-p)
+        # and the walk accepts a child iff its token equals y — every
+        # emitted token is a fresh target-conditional draw, so the output
+        # distribution equals plain sampled incremental decoding's (see
+        # spec_scan._macro_body for the acceptance-rate tradeoff vs the
+        # p/q-ratio rule).  T<=0 keeps the exact-greedy walk.
+        result = self.llm.step(bc, sample=self._sample_arg())
         self.llm_steps += 1
         ids = np.asarray(result.token_ids)
 
         for req in drafting:
-            # greedy accept walk from the root
+            # accept walk from the root (greedy or vs the sampled tokens)
             ni = 0
             accepted_nodes = [0]
             while True:

@@ -143,6 +143,10 @@ class SpecDecodeScan:
         return dict(
             llm_state=self.llm.state,
             ssm_state=self.ssm.state,
+            # global macro counter: the stochastic-verify key folds on THIS
+            # (not the per-call scan index), so windowed run() calls sharing
+            # one sample key never replay per-step keys
+            macro_ctr=jnp.zeros((), jnp.int32),
             root=jnp.asarray(root_tokens, jnp.int32),
             llm_comm=jnp.asarray(llm_committed, jnp.int32),
             ssm_comm=jnp.asarray(ssm_committed, jnp.int32),
@@ -154,13 +158,16 @@ class SpecDecodeScan:
             finished=jnp.asarray(finished, bool),
         )
 
-    def run(self, carry, n_macro: int):
+    def run(self, carry, n_macro: int, sample=None):
         """Run ``n_macro`` macro-steps on device.
 
         Returns ``(emitted, carry)`` where ``emitted`` is
         ``i32[n_macro, R, depth+1]`` (-1 = no token) and the carry holds the
         updated KV caches + bookkeeping.  Caches are donated.  The caller
         must ensure ``llm_comm + n_macro*(depth+1) + depth < max_seq_len``.
+
+        ``sample``: optional ``(key, temperature, top_p)`` — stochastic
+        verification (see ``_macro_body``); greedy argmax walk if None.
         """
         worst = int(np.max(np.asarray(carry["llm_comm"]))) \
             + n_macro * (self.depth + 1) + self.depth
@@ -175,7 +182,7 @@ class SpecDecodeScan:
                 f"SSM max_seq_len {self.ssm.max_seq_len}"
             )
         emitted, carry = self._scan(
-            self.llm.params, self.ssm.params, carry, n_macro=n_macro
+            self.llm.params, self.ssm.params, carry, sample, n_macro=n_macro
         )
         # keep the managers' views of their caches current
         self.llm.state = carry["llm_state"]
@@ -183,14 +190,20 @@ class SpecDecodeScan:
         return emitted, carry
 
     # ------------------------------------------------------------------
-    def _scan_impl(self, llm_params, ssm_params, carry, n_macro: int):
+    def _scan_impl(self, llm_params, ssm_params, carry, sample,
+                   n_macro: int):
         def body(c, _):
-            return self._macro_body(llm_params, ssm_params, c)
+            stp = None
+            if sample is not None:
+                key, temperature, top_p = sample
+                stp = (jax.random.fold_in(key, c["macro_ctr"]),
+                       temperature, top_p)
+            return self._macro_body(llm_params, ssm_params, c, stp)
 
         carry, emitted = jax.lax.scan(body, carry, None, length=n_macro)
         return emitted, carry
 
-    def _macro_body(self, llm_params, ssm_params, c):
+    def _macro_body(self, llm_params, ssm_params, c, sample=None):
         R, W, D, P = (self.llm.max_requests, self.width, self.depth,
                       self.n_tree)
         fin = c["finished"]
@@ -288,11 +301,23 @@ class SpecDecodeScan:
             commit_dst_position=_pad_flat(
                 jnp.where(commit_valid, c["commit_dst"], 0), cap_l, 0),
         )
+        # Stochastic verification (SpecInfer's sampling-based accept,
+        # SURVEY §3.4): when ``sample`` is set, the verify step SAMPLES
+        # y ~ p(target | node prefix) at every tree node (temperature +
+        # top-p, seeded) instead of taking the argmax; the walk below then
+        # accepts a child iff its draft token equals the sampled y.  Every
+        # emitted token — accepted, correction, or bonus — is therefore a
+        # fresh draw from the target conditional, so the output distribution
+        # is EXACTLY the target model's sampling distribution for any draft
+        # (per-node acceptance Σ p·q, vs Σ min(p,q) for the p/q-ratio
+        # rejection rule — slightly lower acceptance, but no draft
+        # distributions needed at verify time, and the same walk serves both
+        # modes; T→0 recovers the greedy walk exactly).
         res_v, llm_state = self.llm._step_impl(
-            llm_params, c["llm_state"], bc_v, tree_layout=(R, P))
+            llm_params, c["llm_state"], bc_v, sample, tree_layout=(R, P))
         ids2 = res_v.token_ids[: R * P].reshape(R, P)              # [R, P]
 
-        # ---- 4. greedy accept walk ----
+        # ---- 4. accept walk (greedy or against the sampled tokens) ----
         def walk(wc, _):
             ni, alive = wc                                         # [R], [R]
             want = jnp.take_along_axis(ids2, ni[:, None], 1)[:, 0]
@@ -336,6 +361,7 @@ class SpecDecodeScan:
         c2 = dict(
             llm_state=llm_state,
             ssm_state=ssm_state,
+            macro_ctr=c["macro_ctr"] + 1,
             root=jnp.where(fin_new, c["root"], root_new),
             llm_comm=c["llm_comm"] + cnt,
             ssm_comm=ssm_comm,

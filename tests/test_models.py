@@ -206,3 +206,87 @@ def test_vision_models_forward_and_train(builder):
     np.testing.assert_allclose(logits.sum(-1), 1.0, atol=1e-5)
     hist = ff.fit(X, y, epochs=2, batch_size=batch, verbose=False)
     assert np.isfinite(hist[-1]["loss"])
+
+
+# ---------------------------------------------------------------------------
+# AggregateSpec + Cache (VERDICT r3 parity stragglers)
+# ---------------------------------------------------------------------------
+def test_aggregate_spec_consistent_with_aggregate():
+    # gate-weighting the per-choice AggregateSpec rows must reproduce
+    # Aggregate's blended output (ample capacity, k=2)
+    from flexflow_tpu.core.op import OpContext
+    from flexflow_tpu.ops.moe import Aggregate, AggregateSpec, GroupBy
+
+    n, d, e, k = 6, 4, 3, 2
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, d), jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.randn(n, e), jnp.float32))
+    gb = GroupBy(e, k=k, capacity_factor=float(n))
+    disp, comb = gb.lower(OpContext(), [x, gates], {})
+    eo = jnp.tanh(disp)  # stand-in expert computation
+    (blended,) = Aggregate().lower(OpContext(), [eo, comb], {})
+    (per_k,) = AggregateSpec(k).lower(OpContext(), [eo, comb, gates], {})
+    assert per_k.shape == (n, k, d)
+    topv, _ = jax.lax.top_k(gates, k)
+    want = jnp.einsum("nk,nkd->nd", topv, per_k)
+    np.testing.assert_allclose(np.asarray(blended), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_aggregate_spec_rows_are_unweighted_expert_outputs():
+    # k=1: row 0 must be the selected expert's RAW output (no gate weight)
+    from flexflow_tpu.core.op import OpContext
+    from flexflow_tpu.ops.moe import AggregateSpec, GroupBy
+
+    n, d, e = 4, 3, 2
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(n, d), jnp.float32)
+    gates = jax.nn.softmax(jnp.asarray(rng.randn(n, e), jnp.float32))
+    gb = GroupBy(e, k=1, capacity_factor=float(n))
+    disp, comb = gb.lower(OpContext(), [x, gates], {})
+    eo = disp * 2.0  # expert doubles its input
+    (per_k,) = AggregateSpec(1).lower(OpContext(), [eo, comb, gates], {})
+    np.testing.assert_allclose(np.asarray(per_k[:, 0]), np.asarray(x) * 2.0,
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_cache_op_replays_stored_value():
+    from flexflow_tpu.core.op import OpContext
+    from flexflow_tpu.ops.misc import Cache
+
+    op = Cache()
+    x1 = jnp.asarray(np.random.RandomState(0).randn(4, 3), jnp.float32)
+    x2 = x1 + 1.0
+    ctx = OpContext()
+    (out1,) = op.lower(ctx, [x1], {})
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(x1))
+    state = ctx.extras["state_out"]
+    # use mode: input changed, output must be the STORED value
+    ctx2 = OpContext(extras={"state": state, "cache_use": True})
+    (out2,) = op.lower(ctx2, [x2], {})
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(x1))
+    # use mode without state is a hard error
+    with pytest.raises(ValueError):
+        Cache().lower(OpContext(extras={"cache_use": True}), [x2], {})
+
+
+def test_cache_op_through_stateful_forward():
+    # graph-level: the interpreter threads Cache state like the KV caches
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    ff = FFModel(FFConfig(batch_size=4), mesh=mesh)
+    x_in = ff.create_tensor((4, 8))
+    c = ff.cache(x_in, name="feat_cache")
+    out = ff.dense(c, 8, use_bias=False, name="head")
+    ff.compile(outputs=[out], loss_type="identity")
+
+    from flexflow_tpu.core.interpreter import build_forward
+
+    fwd = build_forward(ff.plan, mode="spmd")
+    x1 = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    x2 = x1 * -3.0
+    tid = ff.graph.input_tids[0]
+    (o1,), st = fwd(ff.params, {tid: jnp.asarray(x1)}, state={}, extras={})
+    (o2,), _ = fwd(ff.params, {tid: jnp.asarray(x2)}, state=st,
+                   extras={"cache_use": True})
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(o1),
+                               atol=1e-6, rtol=1e-6)
