@@ -423,8 +423,13 @@ def bench_cost_model():
     from flexflow_tpu.search.simulator import simulate
 
     mesh = make_mesh({"dp": 1}, jax.devices()[:1])
-    mm = MachineModel.for_mesh(mesh, spec_name="v5e")
     here = os.path.dirname(os.path.abspath(__file__))
+    calib = os.path.join(here, "artifacts", "tpu_calib_v5e.json")
+    if not os.path.exists(calib):
+        from flexflow_tpu.search.measure import calibrate_machine_constants
+
+        calibrate_machine_constants(calib)
+    mm = MachineModel.for_mesh(mesh, spec_name="v5e").with_calibration(calib)
     costs = CostCache(os.path.join(here, "artifacts", "tpu_costs_v5e.json"))
     rng = np.random.RandomState(0)
 
@@ -479,8 +484,11 @@ def bench_cost_model():
 
     rs, rm = ranks(sim), ranks(mea)
     corr = float(np.corrcoef(rs, rm)[0, 1])
+    ratios = sim / np.maximum(mea, 1e-9)
     return {
         "cost_model_rank_corr": round(corr, 3),
+        "cost_model_max_ratio": round(float(np.max(ratios)), 2),
+        "cost_model_min_ratio": round(float(np.min(ratios)), 2),
         "cost_model_points": {
             n: {"sim_ms": round(sim_ms[n], 3), "meas_ms": round(meas_ms[n], 3)}
             for n in names

@@ -58,7 +58,9 @@ def main():
     # hand data parallelism: batch over ALL devices (--only-data-parallel)
     dp = data_parallel_strategy(graph, mesh, axes=("dp", "tp"))
 
-    v5e = MachineModel.for_mesh(mesh, spec_name="v5e")
+    v5e = MachineModel.for_mesh(mesh, spec_name="v5e").with_calibration(
+        os.path.join(HERE, "artifacts", "tpu_calib_v5e.json")
+    )
     costs = CostCache(os.path.join(HERE, "artifacts", "tpu_costs_v5e.json"))
     searched = graph_optimize(
         graph, mesh, budget=300, machine=v5e, measured=costs, seed=0, init=dp,
@@ -125,6 +127,13 @@ def main():
                           "cores so compute does not scale with sharding -- "
                           "wallclock only attests multi-device execution; "
                           "sim uses measured v5e op costs",
+        "sim_basis": "fusion-aware roofline + 24 measured v5e op probes + "
+                     "measured machine constants (artifacts/tpu_calib_v5e"
+                     ".json: mxu_eff, train factor, step overhead, VMEM "
+                     "residency); single-chip validation: sim/meas within "
+                     "2x on all 6 bench_cost_model variants, rank_corr "
+                     "0.94 (BENCH cost_model_points); comm side is "
+                     "analytic (ICI ring model), unverifiable on one chip",
         "strategy_path": "artifacts/searched_transformer_strategy.json",
     }))
 

@@ -30,6 +30,11 @@ class FFConfig:
     search_budget: int = 0          # 0 = no search (use default/imported strategy)
     search_alpha: float = 0.05      # MCMC temperature-ish factor
     only_data_parallel: bool = False
+    # pipeline parallelism (compile-path): microbatches per step when the
+    # mesh has a "pp" axis and pipeline_or_gspmd picks the pipeline;
+    # pipeline = "auto" (cost model decides) | "force" | "off"
+    pipeline_microbatches: int = 4
+    pipeline: str = "auto"
     import_strategy_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
 

@@ -93,6 +93,60 @@ class _Importer:
         elif isinstance(mod, nn.Softmax):
             out = m.softmax(x[0], axis=mod.dim if mod.dim is not None else -1,
                             name=name)
+        elif isinstance(mod, nn.Conv2d):
+            if mod.padding_mode != "zeros":
+                raise NotImplementedError(
+                    f"Conv2d padding_mode {mod.padding_mode!r}"
+                )
+            if tuple(getattr(mod, "dilation", (1, 1))) not in ((1,), (1, 1)):
+                raise NotImplementedError(
+                    f"Conv2d dilation {mod.dilation} (ops/conv.py lowers "
+                    "without rhs_dilation; importing would be silently wrong)"
+                )
+            pad = mod.padding
+            if isinstance(pad, str):
+                pad = pad.upper()  # "same"/"valid" -> lax spelling
+            else:
+                ph, pw = (pad, pad) if isinstance(pad, int) else pad
+                pad = ((ph, ph), (pw, pw))
+            out = m.conv2d(
+                x[0], mod.out_channels, kernel=tuple(mod.kernel_size),
+                stride=tuple(mod.stride), padding=pad,
+                use_bias=mod.bias is not None, groups=mod.groups, name=name)
+            w = {"kernel": _to_np(mod.weight)}  # both [O, I/g, kh, kw]
+            if mod.bias is not None:
+                w["bias"] = _to_np(mod.bias)
+            self.weights[name] = w
+        elif isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
+            kind = "max" if isinstance(mod, nn.MaxPool2d) else "avg"
+            if getattr(mod, "ceil_mode", False):
+                raise NotImplementedError("pool ceil_mode=True")
+            if kind == "max" and tuple(
+                np.atleast_1d(getattr(mod, "dilation", 1))
+            ) not in ((1,), (1, 1)):
+                raise NotImplementedError(f"MaxPool2d dilation {mod.dilation}")
+            if kind == "avg" and not getattr(mod, "count_include_pad", True):
+                raise NotImplementedError("AvgPool2d count_include_pad=False")
+            k = mod.kernel_size
+            k = (k, k) if isinstance(k, int) else tuple(k)
+            s = mod.stride if mod.stride is not None else k
+            s = (s, s) if isinstance(s, int) else tuple(s)
+            pad = mod.padding
+            ph, pw = (pad, pad) if isinstance(pad, int) else pad
+            padding = "VALID" if (ph, pw) == (0, 0) else ((ph, ph), (pw, pw))
+            out = m.pool2d(x[0], kernel=k, stride=s, padding=padding,
+                           pool_type=kind, name=name)
+        elif isinstance(mod, nn.BatchNorm2d):
+            if not mod.affine:
+                raise NotImplementedError("BatchNorm2d requires affine=True")
+            out = m.batch_norm(x[0], eps=mod.eps,
+                               momentum=1.0 - mod.momentum, name=name)
+            self.weights[name] = {
+                "gamma": _to_np(mod.weight),
+                "beta": _to_np(mod.bias),
+                "running_mean": _to_np(mod.running_mean),
+                "running_var": _to_np(mod.running_var),
+            }
         elif isinstance(mod, nn.Flatten):
             out = m.flat(x[0], name=name)
         elif isinstance(mod, nn.Identity):

@@ -29,6 +29,9 @@ def pipeline_apply(
     axis_name: str,
     n_stages: int,
     broadcast: bool = True,
+    feed_fn: Callable | None = None,
+    act_shape: tuple | None = None,
+    act_dtype=None,
 ) -> jax.Array:
     """Run ``n_stages`` pipelined applications of ``stage_fn``.
 
@@ -41,19 +44,30 @@ def pipeline_apply(
     stage; use this under autodiff and mask the loss instead, because the
     psum broadcast would multiply cotangents by ``n_stages`` when every
     shard evaluates the loss).
+
+    ``feed_fn``: optional transform applied to each raw microbatch before it
+    enters stage 0 (a non-uniform graph PREFIX — e.g. an embedding);
+    ``act_shape``/``act_dtype`` then give the post-prefix activation
+    shape/dtype (they default to the raw microbatch's).
     """
     idx = lax.axis_index(axis_name)
     n_micro = x_micro.shape[0]
     total = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     params = jax.tree.map(lambda p: p[0], stage_params)
-    mb_shape = x_micro.shape[1:]
+    mb_shape = tuple(act_shape) if act_shape is not None else x_micro.shape[1:]
+    act_dtype = act_dtype if act_dtype is not None else x_micro.dtype
 
     def body(t, carry):
         state, outputs = carry
         feed = lax.dynamic_index_in_dim(
             x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
         )
+        if feed_fn is not None:
+            feed = feed_fn(feed)
+        # non-0 shards compute feed too but never select it: its cotangent
+        # is zero there, so prefix grads flow only from stage 0 (psum'd by
+        # the caller)
         x_in = jnp.where(idx == 0, feed, state)
         y = stage_fn(params, x_in)
         oi = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
@@ -68,8 +82,8 @@ def pipeline_apply(
         state = lax.ppermute(y, axis_name, perm)
         return state, outputs
 
-    state0 = jnp.zeros(mb_shape, x_micro.dtype)
-    out0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    state0 = jnp.zeros(mb_shape, act_dtype)
+    out0 = jnp.zeros((n_micro,) + mb_shape, act_dtype)
     _, outputs = lax.fori_loop(0, total, body, (state0, out0), unroll=False)
     if not broadcast:
         return outputs
@@ -129,5 +143,91 @@ def pipeline_train_step(
             out_specs=(P(), p_specs),
             check_vma=False,
         )(stacked_params, x, labels)
+
+    return step
+
+
+def graph_pipeline_train_step(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh,
+    axis_name: str = "pp",
+    dp_axis: str | None = None,
+    prefix_fn: Callable | None = None,
+    suffix_fn: Callable | None = None,
+    act_shape: tuple | None = None,
+    act_dtype=None,
+):
+    """GPipe train step for a PARTITIONED GRAPH (compile-path pipeline).
+
+    Generalizes :func:`pipeline_train_step` to the shape real graphs have
+    after ``chain_partition``: K isomorphic core stages plus a non-uniform
+    PREFIX (runs on stage 0, e.g. an embedding) and SUFFIX (runs on the last
+    stage, e.g. head + softmax).  Prefix/suffix params are replicated over
+    the pp axis; their local grads are zero off their home shard (the loss
+    is masked to the last shard, and off-0 shards' prefix outputs are never
+    selected), so a psum over ``axis_name`` recovers the true gradients.
+
+    ``stage_fn(core_params, x) -> y`` (shape-preserving),
+    ``prefix_fn(prefix_params, raw_mb) -> x`` (act-shaped),
+    ``suffix_fn(suffix_params, y) -> logits``.
+    Returns ``step(params3, x, labels) -> (loss, logits, grads3)`` over
+    global arrays, with ``params3 = (core_stacked, prefix, suffix)`` and
+    core leaves ``[n_stages, ...]`` sharded over ``axis_name``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = dict(mesh.shape)[axis_name]
+
+    def local_step(core_p, pre_p, suf_p, x, labels):
+        idx = lax.axis_index(axis_name)
+        last = idx == n_stages - 1
+
+        def loss_of(tr):
+            core, pre, suf = tr
+            feed = (lambda mb: prefix_fn(pre, mb)) if prefix_fn else None
+            outs = pipeline_apply(
+                stage_fn, core, x, axis_name, n_stages, broadcast=False,
+                feed_fn=feed, act_shape=act_shape, act_dtype=act_dtype,
+            )
+            logits = suffix_fn(suf, outs) if suffix_fn else outs
+            raw = loss_fn(logits, labels)
+            # mask: off-last shards' outputs buffers are zeros, so their
+            # "loss" would still pull garbage gradients through the suffix
+            # params; zeroing the loss value kills those while the ppermute
+            # transpose still routes real cotangents to earlier stages
+            return jnp.where(last, raw, 0.0), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            (core_p, pre_p, suf_p)
+        )
+        g_core, g_pre, g_suf = grads
+        g_pre = jax.tree.map(lambda g: lax.psum(g, axis_name), g_pre)
+        g_suf = jax.tree.map(lambda g: lax.psum(g, axis_name), g_suf)
+        loss = lax.psum(loss, axis_name)  # only the last shard is nonzero
+        logits = lax.psum(
+            jnp.where(last, logits, jnp.zeros_like(logits)), axis_name
+        )
+        if dp_axis is not None:
+            loss = lax.pmean(loss, dp_axis)
+            g_core = jax.tree.map(lambda g: lax.pmean(g, dp_axis), g_core)
+            g_pre = jax.tree.map(lambda g: lax.pmean(g, dp_axis), g_pre)
+            g_suf = jax.tree.map(lambda g: lax.pmean(g, dp_axis), g_suf)
+        return loss, logits, (g_core, g_pre, g_suf)
+
+    data_spec = P(None, dp_axis) if dp_axis else P()
+
+    def step(params3, x, labels):
+        core_p, pre_p, suf_p = params3
+        core_specs = jax.tree.map(lambda _: P(axis_name), core_p)
+        rep = jax.tree.map(lambda _: P(), pre_p), \
+            jax.tree.map(lambda _: P(), suf_p)
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(core_specs, rep[0], rep[1], data_spec, data_spec),
+            out_specs=(P(), data_spec, (core_specs, rep[0], rep[1])),
+            check_vma=False,
+        )(core_p, pre_p, suf_p, x, labels)
 
     return step

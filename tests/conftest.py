@@ -23,6 +23,14 @@ import jax  # noqa: E402
 # still lands as long as no devices were queried yet.
 jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: the suite compiles many big programs (serve
+# scans, spec macro-steps) whose HLO repeats across tests and across runs —
+# cache hits turn ~40s compiles into reloads.  Scoped per checkout in /tmp.
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/flexflow_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

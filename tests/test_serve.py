@@ -36,18 +36,34 @@ TINY = ServeModelConfig(
 )
 
 
+# InferenceManagers are cached by their full config and RE-INITIALIZED per
+# call (fresh seeded params + empty caches): the instance-bound jitted
+# programs are the expensive part, and repeated identical configs across
+# the serve test files were re-paying identical compiles (suite-time trim,
+# VERDICT r3 #10).  Same-config handles within one test refer to the SAME
+# object — every existing use finishes with the first handle before
+# building the second, and identical seeds made their params equal anyway.
+_IM_CACHE = {}
+
+
 def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
             max_spec=0, cfg=TINY, topk=0, seed=7, use_pallas="auto"):
     axes = mesh_axes or {"tp": 1}
-    n = int(np.prod(list(axes.values())))
-    mesh = make_mesh(axes, jax.devices()[:n])
-    ff = FFModel(FFConfig(), mesh=mesh)
-    build_model(ff, cfg, max_tokens)
-    im = InferenceManager(
-        ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
-        max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
-        use_pallas=use_pallas,
-    )
+    key = (tuple(sorted(axes.items())), max_tokens, max_requests, max_seq,
+           max_spec, repr(cfg), topk, seed, use_pallas)
+    im = _IM_CACHE.get(key)
+    if im is None:
+        n = int(np.prod(list(axes.values())))
+        mesh = make_mesh(axes, jax.devices()[:n])
+        ff = FFModel(FFConfig(), mesh=mesh)
+        build_model(ff, cfg, max_tokens)
+        im = InferenceManager(
+            ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
+            max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
+            use_pallas=use_pallas,
+        )
+        _IM_CACHE[key] = im
+    im.tree_token_layout = None  # allow a new SpecDecodeScan binding
     im.init_operators_inference(rng=jax.random.PRNGKey(seed))
     return im
 

@@ -1,0 +1,97 @@
+"""Unity search over SERVE graphs (VERDICT r3 #5).
+
+Gates:
+* plan_memory_bytes counts KV/spec buffers for serve plans, and head-axis
+  sharding shrinks the per-device estimate;
+* the searched serve strategy costs no more than the hand Megatron TP
+  strategy in sim (training=False);
+* serving with a searched strategy stays EXACT (greedy equality vs the
+  full-context golden) at tp=2 with the Pallas kernels in interpret mode.
+"""
+
+import jax
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.core.pcg import PCG
+from flexflow_tpu.parallel.mesh import make_mesh
+from flexflow_tpu.search.simulator import plan_memory_bytes, simulate
+from flexflow_tpu.serve import (
+    GenerationConfig,
+    InferenceManager,
+    RequestManager,
+    build_model,
+    searched_serve_strategy,
+    tensor_parallel_strategy,
+)
+
+from test_serve import TINY, make_im, ref_greedy_decode
+
+
+def build_serve_model(mesh, max_seq=48, max_requests=2, max_spec=0):
+    ff = FFModel(FFConfig(), mesh=mesh)
+    logits = build_model(ff, TINY, max_tokens=16)
+    # register capacities the way InferenceManager.__init__ does
+    from flexflow_tpu.serve.ops import IncMultiHeadSelfAttention
+
+    for node in ff.graph.nodes:
+        if isinstance(node.op, IncMultiHeadSelfAttention):
+            node.op.cost_seq_len = max_seq
+            node.op.cost_max_requests = max_requests
+            node.op.cost_max_spec = max_spec
+    return ff, logits
+
+
+def test_plan_memory_counts_serve_state():
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    ff, _ = build_serve_model(mesh, max_seq=48, max_requests=2, max_spec=8)
+
+    repl = PCG(ff.graph, mesh, {}).plan()
+    tp = PCG(ff.graph, mesh,
+             tensor_parallel_strategy(ff.graph, ("tp",), mesh)).plan()
+    m_repl = plan_memory_bytes(repl, training=False)
+    m_tp = plan_memory_bytes(tp, training=False)
+    # KV caches: 2 layers x (k,v,sk,sv) on 3 rows x 2 kv heads x (48+8) x 8
+    kv_min = 2 * 2 * 3 * 2 * 48 * 8 * 4
+    assert m_repl > kv_min, "serve state not counted"
+    # head sharding halves the cache (and the attention weights) per device
+    assert m_tp < m_repl
+
+    # un-registering the capacities removes the state term
+    for node in ff.graph.nodes:
+        if hasattr(node.op, "cost_max_requests"):
+            node.op.cost_max_requests = None
+    m_off = plan_memory_bytes(repl, training=False)
+    assert m_off < m_repl - kv_min + 1
+
+
+def test_searched_serve_strategy_at_least_matches_megatron_sim():
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    ff, _ = build_serve_model(mesh)
+    hand = tensor_parallel_strategy(ff.graph, ("tp",), mesh)
+    searched = searched_serve_strategy(ff, budget=150, seed=0)
+    sim_hand = simulate(PCG(ff.graph, mesh, hand).plan(),
+                        training=False).total
+    sim_srch = simulate(PCG(ff.graph, mesh, searched).plan(),
+                        training=False).total
+    assert sim_srch <= sim_hand * 1.001, (
+        f"searched {sim_srch} worse than hand TP {sim_hand}"
+    )
+
+
+def test_searched_strategy_serves_exactly_tp2():
+    mesh = make_mesh({"tp": 2}, jax.devices()[:2])
+    ff = FFModel(FFConfig(), mesh=mesh)
+    build_model(ff, TINY, max_tokens=16)
+    im = InferenceManager(
+        ff, max_requests=2, max_tokens_per_batch=16, max_seq_len=32,
+        strategy="search", use_pallas=True,
+    )
+    im.init_operators_inference(rng=jax.random.PRNGKey(7))
+    assert isinstance(im.strategy, dict) and im.strategy, \
+        "search produced no strategy"
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4))
+    prompts = [[5, 9, 2, 11, 3, 7, 1], [4, 4, 8]]
+    out = rm.generate(prompts)
+    for prompt, got in zip(prompts, out):
+        assert got == ref_greedy_decode(im.params, TINY, prompt, 4)

@@ -8,26 +8,39 @@ sampled incremental decoding, for any draft.  Gates here:
 * T=0 / tiny-T with the sampling plumbing active must reproduce the greedy
   walk EXACTLY (both the host manager and the on-device scan);
 * sampling is seeded-deterministic and seed-sensitive at high T.
+
+One rig (LLM + SSM + scan) is built per module and RESET between runs —
+the compiled programs are the expensive part, and they are identical
+across these tests (suite-time trim, VERDICT r3 #10).
 """
 
 import jax
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from flexflow_tpu.serve import GenerationConfig, SpecInferManager
-
-from test_serve import make_im
-from test_spec_scan import PROMPTS, TINY_SSM, prefill, scan_generate
 from flexflow_tpu.serve.spec_scan import SpecDecodeScan
 
+from test_serve import make_im
+from test_spec_scan import PROMPTS, TINY_SSM, prefill
 
-def scan_emitted(sample, n_macro=6, width=2, depth=2):
+
+@pytest.fixture(scope="module")
+def rig():
     llm = make_im(max_tokens=32, max_requests=2, max_seq=96, max_spec=8)
     ssm = make_im(max_tokens=32, max_requests=2, max_seq=96, max_spec=8,
-                  cfg=TINY_SSM, topk=max(width, 1), seed=123)
+                  cfg=TINY_SSM, topk=2, seed=123)
+    sc = SpecDecodeScan(llm, ssm, width=2, depth=2)
+    return llm, ssm, sc
+
+
+def scan_emitted(rig, sample, n_macro=6):
+    llm, ssm, sc = rig
+    llm.reset()
+    ssm.reset()
     firsts = prefill(llm, PROMPTS)
     prefill(ssm, PROMPTS)
-    sc = SpecDecodeScan(llm, ssm, width=width, depth=depth)
     carry = sc.init_carry(
         firsts, [len(p) for p in PROMPTS], [len(p) for p in PROMPTS],
         [False] * len(PROMPTS),
@@ -36,64 +49,73 @@ def scan_emitted(sample, n_macro=6, width=2, depth=2):
     return np.asarray(emitted)
 
 
-def test_scan_sample_t0_equals_greedy():
-    greedy = scan_emitted(None)
-    t0 = scan_emitted((jax.random.PRNGKey(5), jnp.float32(0.0),
-                       jnp.float32(1.0)))
+def test_scan_sample_t0_equals_greedy(rig):
+    greedy = scan_emitted(rig, None)
+    t0 = scan_emitted(rig, (jax.random.PRNGKey(5), jnp.float32(0.0),
+                            jnp.float32(1.0)))
     np.testing.assert_array_equal(t0, greedy)
 
 
-def test_scan_sample_tiny_t_equals_greedy():
+def test_scan_sample_tiny_t_equals_greedy(rig):
     # T=1e-4 scales logit gaps by 1e4: categorical picks the argmax with
     # certainty (no ties at random init), so the whole walk must match
-    greedy = scan_emitted(None)
-    tiny = scan_emitted((jax.random.PRNGKey(5), jnp.float32(1e-4),
-                         jnp.float32(1.0)))
+    greedy = scan_emitted(rig, None)
+    tiny = scan_emitted(rig, (jax.random.PRNGKey(5), jnp.float32(1e-4),
+                              jnp.float32(1.0)))
     np.testing.assert_array_equal(tiny, greedy)
 
 
-def test_scan_sample_seeded_deterministic():
-    a = scan_emitted((jax.random.PRNGKey(7), jnp.float32(2.0),
-                      jnp.float32(1.0)))
-    b = scan_emitted((jax.random.PRNGKey(7), jnp.float32(2.0),
-                      jnp.float32(1.0)))
+def test_scan_sample_seeded_deterministic(rig):
+    a = scan_emitted(rig, (jax.random.PRNGKey(7), jnp.float32(2.0),
+                           jnp.float32(1.0)))
+    b = scan_emitted(rig, (jax.random.PRNGKey(7), jnp.float32(2.0),
+                           jnp.float32(1.0)))
     np.testing.assert_array_equal(a, b)
-    c = scan_emitted((jax.random.PRNGKey(8), jnp.float32(2.0),
-                      jnp.float32(1.0)))
+    c = scan_emitted(rig, (jax.random.PRNGKey(8), jnp.float32(2.0),
+                           jnp.float32(1.0)))
     assert (a != c).any(), "different seeds produced identical samples"
 
 
-def spec_generate(gen):
+@pytest.fixture(scope="module")
+def host_rig():
     llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
     ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
                   cfg=TINY_SSM, topk=2, seed=123)
+    return llm, ssm
+
+
+def spec_generate(host_rig, gen):
+    llm, ssm = host_rig
+    llm.reset()
+    ssm.reset()
     return SpecInferManager(llm, ssm, gen, width=2, depth=2).generate(PROMPTS)
 
 
-def test_host_spec_tiny_t_equals_greedy():
-    greedy = spec_generate(GenerationConfig(max_new_tokens=8))
-    tiny = spec_generate(GenerationConfig(
+def test_host_spec_tiny_t_equals_greedy(host_rig):
+    greedy = spec_generate(host_rig, GenerationConfig(max_new_tokens=8))
+    tiny = spec_generate(host_rig, GenerationConfig(
         max_new_tokens=8, temperature=1e-4, seed=3))
     assert tiny == greedy
 
 
-def test_host_spec_sampling_runs_and_is_seeded():
+def test_host_spec_sampling_runs_and_is_seeded(host_rig):
     gen = GenerationConfig(max_new_tokens=8, temperature=2.0, seed=11)
-    a = spec_generate(gen)
-    b = spec_generate(GenerationConfig(max_new_tokens=8, temperature=2.0,
-                                       seed=11))
+    a = spec_generate(host_rig, gen)
+    b = spec_generate(host_rig, GenerationConfig(
+        max_new_tokens=8, temperature=2.0, seed=11))
     assert a == b
     assert all(len(s) == 8 for s in a)
     vocab = 67  # TINY.vocab_size
     assert all(0 <= t < vocab for s in a for t in s)
-    c = spec_generate(GenerationConfig(max_new_tokens=8, temperature=2.0,
-                                       seed=12))
+    c = spec_generate(host_rig, GenerationConfig(
+        max_new_tokens=8, temperature=2.0, seed=12))
     assert a != c
 
 
-def test_scan_sample_greedy_path_unaffected():
-    # passing sample=None after a sampled run must still equal pure greedy
-    # (regression: the sampling plumbing must not leak into the greedy trace)
-    greedy = scan_generate(2, 2, n_new=10)[0]
-    again = scan_generate(2, 2, n_new=10)[0]
-    assert greedy == again
+def test_scan_sample_greedy_path_unaffected(rig):
+    # greedy runs after sampled runs on the same rig must still be
+    # deterministic (regression: the sampling plumbing must not leak into
+    # the greedy trace)
+    a = scan_emitted(rig, None)
+    b = scan_emitted(rig, None)
+    np.testing.assert_array_equal(a, b)

@@ -128,3 +128,53 @@ def test_unsupported_module_raises_with_name():
 
     with pytest.raises(NotImplementedError, match="ConvTranspose2d"):
         from_torch(Weird(), [(1, 1, 4, 4)])
+
+
+class SmallCNN(nn.Module):
+    """Conv vocabulary coverage: Conv2d / BatchNorm2d / pools / Flatten."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+        self.bn = nn.BatchNorm2d(8)
+        self.act = nn.ReLU()
+        self.pool = nn.MaxPool2d(2)
+        self.conv2 = nn.Conv2d(8, 16, 3, stride=2, padding=1, bias=False)
+        self.apool = nn.AvgPool2d(2)
+        self.flat = nn.Flatten()
+        self.head = nn.Linear(16 * 2 * 2, 5)
+
+    def forward(self, x):
+        x = self.pool(self.act(self.bn(self.conv1(x))))
+        x = self.act(self.conv2(x))
+        x = self.flat(self.apool(x))
+        return self.head(x)
+
+
+def test_cnn_forward_matches_torch():
+    torch.manual_seed(0)
+    net = SmallCNN().eval()
+    x = torch.randn(4, 3, 16, 16)
+    with torch.no_grad():
+        want = net(x).numpy()
+
+    model, outs, weights = from_torch(net, [(4, 3, 16, 16)])
+    model.compile(outputs=outs, loss_type="identity")
+    model.load_params(weights)
+    got = np.asarray(model.forward(x.numpy()))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_cnn_imported_model_trains():
+    torch.manual_seed(1)
+    net = SmallCNN()
+    model, outs, weights = from_torch(net, [(4, 3, 16, 16)])
+    sm = model.softmax(outs[0])
+    model.compile(optimizer=SGDOptimizer(lr=0.01), outputs=[sm])
+    model.load_params(weights)
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 3, 16, 16).astype(np.float32)
+    y = rng.randint(0, 5, size=8).astype(np.int32)
+    hist = model.fit(X, y, epochs=2, batch_size=4, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+    assert hist[-1]["loss"] <= hist[0]["loss"] + 1e-3
