@@ -539,14 +539,10 @@ class FFModel:
                     f"executor ({chain_err}); falling back to GSPMD",
                     stacklevel=2,
                 )
-            # None -> the normal resolution continues (substitution search
-            # when search_budget > 0, else data-parallel fallback)
-            if cfg.search_budget > 0:
-                return None
-            from .search.search import graph_optimize
-
-            return graph_optimize(self.graph, mesh, budget=budget,
-                                  seed=cfg.seed, training=True)
+            # None -> the DOCUMENTED resolution continues (substitution
+            # search when search_budget > 0, else the cheap data-parallel
+            # fallback — never a search the user didn't budget for)
+            return None
         if getattr(cfg, "pipeline", "auto") == "force":
             stage_of, _cost = propose_pipeline(
                 self.graph, mesh, "pp", n_micro=cfg.pipeline_microbatches,
@@ -571,12 +567,7 @@ class FFModel:
                 f"drive the GPipe executor ({e}); falling back to GSPMD",
                 stacklevel=2,
             )
-            if cfg.search_budget > 0:
-                return None
-            from .search.search import graph_optimize
-
-            return graph_optimize(self.graph, mesh, budget=budget,
-                                  seed=cfg.seed, training=True)
+            return None  # documented resolution: search if budgeted, else dp
         self._pipeline_ctx = (strategy, carve)
         return strategy
 

@@ -94,22 +94,20 @@ class SpecDecodeScan:
             raise ValueError(f"SSM needs topk >= width ({self.width})")
         from .ops import DUS_MAX_TOKENS
 
-        # _scatter_rows_pos switches paths on the CAPACITY-PADDED array
-        # length, not the live token count: inside the jitted macro step the
-        # commit descriptor and verify-step KV writes are padded to
-        # llm.max_tokens and the catch-up/draft batches to ssm.max_tokens,
-        # so those capacities are what must stay under the DUS threshold —
-        # a guard on R*(depth+1) alone would pass while the padded arrays
-        # silently took the scatter path (per-macro-step full-cache relayout).
-        for tag, cap_t in (("llm", llm.max_tokens), ("ssm", ssm.max_tokens)):
+        # _scatter_rows_pos switches paths on the flat array length the
+        # step actually ships.  The scan sizes each phase's batch EXACTLY
+        # (verify: R*n_tree, catch-up: R*(depth+1), draft: R*width ≤ both)
+        # instead of padding to max_tokens — capacity padding multiplied the
+        # per-step DUS chains / forward tokens / topk for nothing — so those
+        # exact sizes are what must stay under the DUS threshold.
+        for tag, cap_t in (("verify", R * self.n_tree),
+                           ("catch-up", R * (self.depth + 1))):
             if cap_t > DUS_MAX_TOKENS:
                 raise ValueError(
-                    f"{tag} max_tokens_per_batch ({cap_t}) exceeds the "
-                    f"KV-write DUS threshold ({DUS_MAX_TOKENS}); every "
-                    "KV write inside the macro-step scan is padded to that "
-                    "capacity, so the scatter fallback would force a "
-                    "per-macro-step full-cache relayout — use fewer request "
-                    "slots or a shallower/narrower tree"
+                    f"{tag} batch size ({cap_t}) exceeds the KV-write DUS "
+                    f"threshold ({DUS_MAX_TOKENS}); the scatter fallback "
+                    "would force a per-macro-step full-cache relayout — "
+                    "use fewer request slots or a shallower/narrower tree"
                 )
         # the verify batch always ships exactly n_tree tokens per request in
         # slot-major order -> the LLM can use the batched tree kernel (the
@@ -211,9 +209,14 @@ class SpecDecodeScan:
         kk = jnp.arange(D + 1, dtype=jnp.int32)[None, :]          # [1, D+1]
 
         # ---- 1. SSM catch-up: previous macro-step's accepted tokens ----
+        # every phase compiles its own program (distinct bc pytree), so
+        # each uses EXACT flat sizes instead of padding to ssm.max_tokens —
+        # capacity padding multiplied the per-step KV DUS chains, forward
+        # tokens, and [T, vocab] topk by max_tokens/live (6x at the bench
+        # shape) for no reason
         nb = jnp.where(fin, 0, c["backlog_n"])                     # [R]
         valid = kk < nb[:, None]                                   # [R, D+1]
-        cap = self.ssm.max_tokens
+        cap = R * (D + 1)
         bc_cu = BatchConfig(
             tokens=_pad_flat(jnp.where(valid, c["backlog_tok"], 0), cap, 0),
             request_index=_pad_flat(
@@ -246,13 +249,13 @@ class SpecDecodeScan:
             spec = jnp.broadcast_to(jnp.asarray(f_idx)[None, :], (R, F))
             bc_d = TreeSearchBatchConfig(
                 base=BatchConfig(
-                    tokens=_pad_flat(ftok, cap, 0),
-                    request_index=_pad_flat(reqi, cap, -1),
-                    token_position=_pad_flat(fpos, cap, 0),
+                    tokens=ftok.reshape(-1),        # exact R*F flat slots
+                    request_index=reqi.reshape(-1),
+                    token_position=fpos.reshape(-1),
                     num_tokens=jnp.sum(reqi >= 0),
                     seq_lens=ssm_comm,
                 ),
-                spec_index=_pad_flat(spec, cap, 0),
+                spec_index=spec.reshape(-1),
                 ancestor_mask=self._pad_mask(amask, Pb_s),
                 committed_lens=ssm_comm,
             )
@@ -277,7 +280,7 @@ class SpecDecodeScan:
                 amask, par_rows | own, (0, n0, 0))
 
         # ---- 3. LLM verify (commit descriptor from previous macro) ----
-        cap_l = self.llm.max_tokens
+        cap_l = R * P  # exact: the verify batch is always R full trees
         depth_of = jnp.asarray(self._node_depth)                   # [P]
         reqi_v = jnp.broadcast_to(jnp.where(fin, -1, slot)[:, None], (R, P))
         pos_v = c["llm_comm"][:, None] + depth_of[None, :]
