@@ -88,6 +88,51 @@ def main():
     os.makedirs(os.path.dirname(strat_path), exist_ok=True)
     save_strategy(strat_path, searched, mesh)
 
+    # ---- error bars on the headline ratio (VERDICT r4 #4) --------------
+    # One-at-a-time +/-30% perturbation of the constants the calibration
+    # could plausibly be wrong about.  Two questions per point:
+    #   (a) does the RATIO survive (searched still beats hand-DP in sim)?
+    #   (b) does the ARGMAX survive (re-searching under the perturbed model
+    #       finds a strategy no better than the nominal one, regret <= 5%)?
+    import dataclasses
+
+    def ratio_under(mm):
+        d = simulate(PCG(graph, mesh, dp).plan(), mm, measured=costs).total
+        s = simulate(PCG(graph, mesh, searched).plan(), mm,
+                     measured=costs).total
+        return d / s
+
+    perturb_fields = ("mxu_efficiency", "overlap", "ici_bandwidth",
+                      "train_step_factor")
+    ratios, sens, stable = {}, {}, True
+    for field in perturb_fields:
+        base_val = getattr(v5e.spec, field)
+        for f in (0.7, 1.3):
+            mm_p = MachineModel(
+                dataclasses.replace(v5e.spec, **{field: base_val * f}),
+                v5e.dcn_axes,
+            )
+            key = f"{field}*{f}"
+            ratios[key] = round(ratio_under(mm_p), 3)
+            re_searched = graph_optimize(
+                graph, mesh, budget=300, machine=mm_p, measured=costs,
+                seed=0, init=dp,
+            )
+            t_nom = simulate(PCG(graph, mesh, searched).plan(), mm_p,
+                             measured=costs).total
+            t_re = simulate(PCG(graph, mesh, re_searched).plan(), mm_p,
+                            measured=costs).total
+            regret = t_nom / max(t_re, 1e-12)
+            sens[key] = round(regret, 3)
+            if regret > 1.05:
+                stable = False
+    ratio_range = [min(ratios.values()), max(ratios.values())]
+
+    # which constants moved the r3->r4 1.868->3.511 jump: the same ratio
+    # under the UNCALIBRATED spec-sheet constants (the r3-era basis)
+    v5e_spec = MachineModel.for_mesh(mesh, spec_name="v5e")
+    ratio_speccal = round(ratio_under(v5e_spec), 3)
+
     # wall-clock on the virtual CPU mesh
     def step_time(strategy, steps=6):
         import jax.numpy as jnp
@@ -115,6 +160,22 @@ def main():
 
     print(json.dumps({
         "searched_vs_dp_sim": round(sim_dp / sim_se, 3),
+        "searched_vs_dp_sim_range": [round(r, 3) for r in ratio_range],
+        "searched_vs_dp_sim_speccal": ratio_speccal,
+        "strategy_stable": stable,
+        "perturbation_ratios": ratios,
+        "perturbation_regret": sens,
+        "perturbation_note": "one-at-a-time +/-30% on mxu_efficiency/overlap/"
+                             "ici_bandwidth/train_step_factor; ratio = hand-DP"
+                             "/searched under the perturbed model with the "
+                             "NOMINAL searched strategy; regret = that "
+                             "strategy's sim time / the re-searched optimum "
+                             "under the same perturbed model (stable when "
+                             "<=1.05 everywhere).  *_speccal re-scores both "
+                             "strategies under UNCALIBRATED spec-sheet "
+                             "constants — the r3-era basis — so the r3->r4 "
+                             "headline jump is attributable to calibration "
+                             "vs search",
         "joint_vs_dp_sim": round(sim_dp / sim_joint, 3),
         "rewrites_accepted": rewrites_accepted,
         "searched_vs_dp_wallclock": round(wc_dp / wc_se, 3),

@@ -403,22 +403,37 @@ class Model:
         return model, outs
 
     def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
-                metrics: Sequence[str] = (), batch_size: int = 32):
+                metrics: Sequence[str] = (), batch_size: int = 32,
+                loss_weights=None):
+        """``loss`` may be a single name (applied to the sole output) or a
+        list of names, one per Model output — fit()/evaluate() then take
+        ``y`` as a matching list of label arrays and the training loss is
+        the ``loss_weights``-ed sum (reference Keras frontend's multi-output
+        losses)."""
         if isinstance(optimizer, str):
             try:
                 optimizer = _OPTIMIZERS[optimizer.lower()]()
             except KeyError:
                 raise ValueError(f"unknown optimizer {optimizer!r}")
-        if loss not in _LOSSES:
-            raise ValueError(f"unknown loss {loss!r}")
-        if len(self.outputs) != 1:
-            raise NotImplementedError(
-                "Model supports exactly one output (per-output losses for "
-                "multi-output training are not implemented)"
+        multi = isinstance(loss, (list, tuple))
+        losses = list(loss) if multi else [loss]
+        for l in losses:
+            if l not in _LOSSES:
+                raise ValueError(f"unknown loss {l!r}")
+        if len(losses) != len(self.outputs):
+            raise ValueError(
+                f"{len(losses)} losses for {len(self.outputs)} outputs — "
+                "pass one loss per Model output (a single loss name is only "
+                "valid for a single-output Model)"
             )
         self.model, outs = self._build(batch_size)
-        self.model.compile(optimizer=optimizer, loss_type=_LOSSES[loss],
-                           metrics=list(metrics), outputs=[outs[-1]])
+        self.model.compile(
+            optimizer=optimizer,
+            loss_type=[_LOSSES[l] for l in losses] if multi
+            else _LOSSES[losses[0]],
+            metrics=list(metrics), outputs=outs,
+            loss_weights=loss_weights,
+        )
         return self
 
     def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,

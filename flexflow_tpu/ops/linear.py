@@ -85,6 +85,15 @@ class Linear(Op):
     def lower(self, ctx, inputs, params):
         x = inputs[0]
         kernel = params["kernel"]
+        if kernel.dtype == jnp.int8:
+            # weight-only int8 (reference: Linear's serve quantization
+            # hooks, SURVEY §2.2): per-out-channel scales, dequantized on
+            # chip — XLA fuses the convert*scale into the dot's operand
+            # pipeline, so HBM reads the int8 bytes (half of bf16; decode
+            # is weight-bandwidth-bound).  serve/quant.py installs these.
+            from ..serve.quant import dequant
+
+            kernel = dequant(kernel, params["kernel_scale"], self.dtype)
         y = jnp.dot(x, kernel, preferred_element_type=_acc_dtype(x.dtype))
         partial_in = bool(ctx.config and ctx.config.get("channel_in"))
         if self.use_bias:

@@ -190,7 +190,12 @@ def graph_pipeline_train_step(
                 stage_fn, core, x, axis_name, n_stages, broadcast=False,
                 feed_fn=feed, act_shape=act_shape, act_dtype=act_dtype,
             )
-            logits = suffix_fn(suf, outs) if suffix_fn else outs
+            # suffix per MICROBATCH (vmap over the leading n_micro dim):
+            # its ops treat dim 0 as the batch (e.g. a mean-pool over axis
+            # 1), so applying it to the stacked [n_micro, mb, ...] buffer
+            # directly would hit the wrong axes
+            logits = (jax.vmap(lambda o: suffix_fn(suf, o))(outs)
+                      if suffix_fn else outs)
             raw = loss_fn(logits, labels)
             # mask: off-last shards' outputs buffers are zeros, so their
             # "loss" would still pull garbage gradients through the suffix

@@ -114,12 +114,18 @@ def propose_pipeline(
     strategy: Optional[Dict] = None,
     training: bool = True,
     memory_limit: Optional[float] = None,
+    groups: Optional[Dict[str, int]] = None,
 ) -> Tuple[Dict[str, int], float]:
     """Optimal stage map for the graph's op chain + simulated iteration time.
 
     Per-op times come from the planned PCG under ``strategy`` (non-pp axes
     only) with per-microbatch shapes — the SAME simulator path the MCMC
     scores, so the returned cost is comparable with ``simulate()`` totals.
+
+    ``groups``: optional node-name -> group-index map (contiguous in op
+    order) of SESE segments that must land in one stage — residual blocks
+    collapsed to supernodes (VERDICT r4 #3); the partition then runs over
+    per-group cost sums and expands back to nodes.
     """
     k = dict(mesh.shape)[pp_axis]
     mm = machine or MachineModel.for_mesh(mesh)
@@ -139,7 +145,17 @@ def propose_pipeline(
             param_bytes=_step_param_bytes(s, plan, mesh) * stream_frac)
         for s in steps
     ]
-    stage_of_idx = chain_partition(times, k)
+    if groups:
+        gids = [groups.get(s.node.name, 0) for s in steps]
+        order = sorted(set(gids))
+        gsum = {g: 0.0 for g in order}
+        for t, g in zip(times, gids):
+            gsum[g] += t
+        g_stage = chain_partition([gsum[g] for g in order], k)
+        stage_by_gid = dict(zip(order, g_stage))
+        stage_of_idx = [stage_by_gid[g] for g in gids]
+    else:
+        stage_of_idx = chain_partition(times, k)
 
     # boundary activation bytes per microbatch, PER DEVICE (the producing
     # tensor may be sharded over non-pp axes by the inner strategy, and
@@ -211,6 +227,7 @@ def pipeline_or_gspmd(
     seed: int = 0,
     training: bool = True,
     memory_limit: Optional[float] = None,
+    groups: Optional[Dict[str, int]] = None,
 ):
     """Search both worlds and return the better plan under the cost model.
 
@@ -273,7 +290,7 @@ def pipeline_or_gspmd(
     stage_of, cost_pp = propose_pipeline(
         graph, mesh, pp_axis, n_micro=n_micro, machine=mm,
         measured=measured, strategy=inner, training=training,
-        memory_limit=memory_limit,
+        memory_limit=memory_limit, groups=groups,
     )
     if cost_pp == float("inf") and cost_gspmd == float("inf"):
         raise ValueError(

@@ -129,8 +129,12 @@ class PrefillBatchConfig:
     Contract (enforced by :meth:`build`): with ``Bq = tile_size`` and
     ``G = base.max_tokens // Bq``, flat slot ``g*Bq + b`` belongs to tile
     ``g``; each tile's real tokens (a) belong to ONE request, (b) sit at the
-    tile's head with pad slots only at the tail, and (c) have contiguous
-    ascending positions.  The kernel then reconstructs every per-token causal
+    tile's head with pad slots only at the tail, (c) have contiguous
+    ascending positions, and (d) start at a TILE-ALIGNED position
+    (``start_pos % Bq == 0``) — the attention op writes each tile's KV as
+    one block dynamic-update-slice, and alignment (with the cache's seq
+    capacity a multiple of the tile) guarantees the DUS start is never
+    clamp-shifted.  The kernel then reconstructs every per-token causal
     mask from the tile's first position alone.
     """
 
@@ -179,6 +183,12 @@ class PrefillBatchConfig:
         at = 0
         n = 0
         for slot, toks, start in segments:
+            if start % tile_size:
+                raise ValueError(
+                    f"segment start {start} not aligned to tile_size "
+                    f"{tile_size} (contract (d): the block KV write needs "
+                    "tile-aligned positions)"
+                )
             need = -(-len(toks) // tile_size) * tile_size  # round up to tiles
             if at + need > max_tokens:
                 raise ValueError(

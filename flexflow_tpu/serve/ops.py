@@ -224,6 +224,10 @@ class IncMultiHeadSelfAttention(Op):
             )
         x = inputs[0]  # [T, E]
         qkv_w = params["qkv"]
+        if qkv_w.dtype == jnp.int8:  # weight-only int8 (serve/quant.py)
+            from .quant import dequant
+
+            qkv_w = dequant(qkv_w, params["qkv_scale"], x.dtype)
         q, k, v = self._project(x, qkv_w, params.get("qkv_bias"), bc)
 
         if isinstance(bc, TreeVerifyBatchConfig):
@@ -239,9 +243,14 @@ class IncMultiHeadSelfAttention(Op):
         ctx.extras["state_out"] = state
         # [T, QH, D] -> [T, QH*D] -> o_proj (row-parallel under TP)
         t = out.shape[0]
+        o_w = params["o_proj"]
+        if o_w.dtype == jnp.int8:  # weight-only int8 (serve/quant.py)
+            from .quant import dequant
+
+            o_w = dequant(o_w, params["o_proj_scale"], out.dtype)
         y = jnp.dot(
             out.reshape(t, self.num_q_heads * self.head_dim),
-            params["o_proj"],
+            o_w,
             preferred_element_type=jnp.float32,
         )
         if self.use_bias:
@@ -470,8 +479,6 @@ class IncMultiHeadSelfAttention(Op):
         nreq = kc.shape[0] - 1
         rows = self._rows(base, nreq)
         pos = base.token_position
-        kc = self._scatter_rows_pos(kc, rows, pos, k)
-        vc = self._scatter_rows_pos(vc, rows, pos, v)
 
         t = q.shape[0]
         bq = bc.tile_size
@@ -481,6 +488,35 @@ class IncMultiHeadSelfAttention(Op):
         # row nreq (the largest index), so min() recovers the tile's request
         tile_rows = jnp.min(rows.reshape(g, bq), axis=1)
         pstart = pos.reshape(g, bq)[:, 0]
+        # KV-cache write as G per-tile BLOCK dynamic-update-slices instead of
+        # a flat-token scatter: a prefill chunk carries max_tokens (>
+        # DUS_MAX_TOKENS) tokens, so _scatter_rows_pos would take the XLA
+        # scatter path — whose layout choice forces a full-cache relayout
+        # copy per prefill_scan step (the same hazard _scatter_rows_pos
+        # documents for the decode scan, ~2x the chunk's whole HBM traffic
+        # at the 7B bench shape).  PrefillBatchConfig's contract makes the
+        # block write exact for real tokens: tile g is one request, its
+        # positions contiguous from a TILE-ALIGNED pstart (RequestManager
+        # only advances prefill_offset by whole tiles until completion), so
+        # the DUS start is never clamp-shifted.  Tail-pad slots write ZEROS
+        # at the request's next positions (junk-free: fresh caches are
+        # zeros, so the tiled and flat paths stay bit-identical); even a
+        # non-zero value there would be benign, since every future step
+        # WRITES position p before any token's causal frontier reaches p
+        # (the scratch-row behavior of fully-pad tiles is unchanged: min()
+        # maps them to row nreq).
+        valid = (base.request_index >= 0).reshape(g, 1, bq, 1)
+        kb = k.reshape(g, bq, self.num_kv_heads, self.head_dim) \
+             .transpose(0, 2, 1, 3).astype(kc.dtype)
+        vb = v.reshape(g, bq, self.num_kv_heads, self.head_dim) \
+             .transpose(0, 2, 1, 3).astype(vc.dtype)
+        kb = jnp.where(valid, kb, 0)
+        vb = jnp.where(valid, vb, 0)
+        zero = jnp.int32(0)
+        for i in range(g):
+            at = (tile_rows[i], zero, pstart[i], zero)
+            kc = jax.lax.dynamic_update_slice(kc, kb[i][None], at)
+            vc = jax.lax.dynamic_update_slice(vc, vb[i][None], at)
 
         def attend(q_, kc_, vc_, rows_, pstart_):
             kv_l, gq = q_.shape[1], q_.shape[2]

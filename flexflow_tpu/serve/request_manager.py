@@ -168,7 +168,13 @@ class RequestManager:
         tile = getattr(self.im, "prefill_tile", 1)
         if (not tokens and tile > 1 and self.im.use_pallas
                 and any(r.status is RequestStatus.PREFILLING
-                        for r in self._active())):
+                        for r in self._active())
+                # contract (d): tiled segments need tile-aligned starts; an
+                # unaligned offset (hand-driven flat steps) rides the flat
+                # path instead of crashing the builder
+                and all(r.prefill_offset % tile == 0
+                        for r in self._active()
+                        if r.status is RequestStatus.PREFILLING)):
             segments = []
             for req in self._active():
                 if req.status is not RequestStatus.PREFILLING or budget < tile:
@@ -197,11 +203,21 @@ class RequestManager:
             ]
             return pbc, sample_points
 
-        # then prefill chunks fill the remaining budget
+        # then prefill chunks fill the remaining budget.  Mid-prompt cuts
+        # keep prefill_offset TILE-ALIGNED (round the take down to whole
+        # tiles) so later pure-prefill steps can ride the tiled Pallas path
+        # — PrefillBatchConfig's contract (d) rejects unaligned segment
+        # starts.  Completing takes (remaining <= budget) need no rounding.
         for req in self._active():
             if req.status is not RequestStatus.PREFILLING or budget <= 0:
                 continue
-            take = min(budget, len(req.prompt) - req.prefill_offset)
+            remaining = len(req.prompt) - req.prefill_offset
+            if remaining <= budget:
+                take = remaining
+            else:
+                take = (budget // tile) * tile if tile > 1 else budget
+                if take == 0:
+                    continue  # budget < one tile: keep alignment, wait
             start = req.prefill_offset
             for j in range(take):
                 tokens.append(req.prompt[start + j])
@@ -299,6 +315,7 @@ class RequestManager:
             and bool(active)
             and all(r.status is RequestStatus.PREFILLING for r in active)
             and any(r.prefill_offset < len(r.prompt) for r in active)
+            and all(r.prefill_offset % tile == 0 for r in active)
         )
 
     def _prefill_stretch(self) -> None:

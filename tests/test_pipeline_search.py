@@ -129,3 +129,53 @@ def test_searched_partition_executes_via_gpipe():
     assert np.isfinite(float(loss))
     assert jax.tree.all(
         jax.tree.map(lambda g: bool(jnp.isfinite(g).all()), grads))
+
+
+def bottleneck_chain(mesh, n_blocks=4, wide=16384, narrow=512, batch=2048):
+    """Uniform blocks with a NARROW boundary: narrow->wide->narrow dense
+    pairs, so stage cuts ship tiny activations while the weights are heavy
+    — the shape pipelines love."""
+    model = FFModel(FFConfig(batch_size=batch), mesh=mesh)
+    x = model.create_tensor((batch, narrow))
+    h = x
+    for i in range(n_blocks):
+        h = model.dense(h, wide, activation="relu", name=f"up{i}")
+        h = model.dense(h, narrow, name=f"down{i}")
+    model.softmax(model.dense(h, 8, name="head"))
+    return model
+
+
+def test_pipeline_vs_gspmd_cost_boundary():
+    """VERDICT r4 #7: the consult's decision follows the COST crossover,
+    not just the memory-forced flip.  Same graph, same machine, no memory
+    cap — only the microbatch count moves across the boundary:
+
+    * n_micro=16: bubble (M+K-1)/M = 1.19, boundary acts are narrow, and
+      GSPMD must either leave the DCN-backed pp axis idle (4x less
+      parallelism) or reshard per layer across hosts -> pipeline wins
+      (probed: 3.54ms vs GSPMD 4.46ms, a 26% margin).
+    * n_micro=1: the GPipe schedule degenerates to K sequential stages
+      (bubble factor K) with zero overlap -> GSPMD wins.
+
+    Sensitivity: the n_micro=1 side depends only on the bubble arithmetic
+    (machine-constant-free); the n_micro=16 side is most sensitive to
+    mxu_efficiency (which scales the compute the bubble multiplies against
+    GSPMD's 2-way-only sharding) and dcn_bandwidth/latency (boundary
+    shipping, charged per microbatch per cut).
+    """
+    mesh = make_mesh({"pp": 4, "dp": 2}, jax.devices()[:8])
+    model = bottleneck_chain(mesh)
+    mm = MachineModel.for_mesh(mesh, spec_name="v5e", dcn_axes=("pp",))
+
+    kind_hi, _, stage_hi, cost_hi = pipeline_or_gspmd(
+        model.graph, mesh, "pp", n_micro=16, machine=mm, budget=120, seed=0,
+        memory_limit=0,
+    )
+    assert kind_hi == "pipeline", f"n_micro=16: got {kind_hi} ({cost_hi})"
+    assert stage_hi is not None and len(set(stage_hi.values())) == 4
+
+    kind_lo, _, _, cost_lo = pipeline_or_gspmd(
+        model.graph, mesh, "pp", n_micro=1, machine=mm, budget=120, seed=0,
+        memory_limit=0,
+    )
+    assert kind_lo == "gspmd", f"n_micro=1: got {kind_lo} ({cost_lo})"

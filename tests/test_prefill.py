@@ -99,8 +99,8 @@ def test_prefill_tiled_equals_flat_path():
 
 def test_prefill_batch_config_contract():
     pbc, last = PrefillBatchConfig.build(
-        [(0, [1, 2, 3], 0), (1, [4, 5, 6, 7, 8], 10)],
-        [3, 15], tile_size=4, max_tokens=16, max_requests=4,
+        [(0, [1, 2, 3], 0), (1, [4, 5, 6, 7, 8], 12)],
+        [3, 17], tile_size=4, max_tokens=16, max_requests=4,
     )
     base = pbc.base
     req = np.asarray(base.request_index)
@@ -109,12 +109,19 @@ def test_prefill_batch_config_contract():
     assert list(req[:4]) == [0, 0, 0, -1]
     assert list(req[4:12]) == [1] * 5 + [-1] * 3
     assert list(pos[:3]) == [0, 1, 2]
-    assert list(pos[4:9]) == [10, 11, 12, 13, 14]
+    assert list(pos[4:9]) == [12, 13, 14, 15, 16]
     assert last == {0: 2, 1: 8}
     assert pbc.num_tiles == 4
     with pytest.raises(ValueError):
         PrefillBatchConfig.build(
             [(0, list(range(20)), 0)], [20], tile_size=4,
+            max_tokens=16, max_requests=4,
+        )
+    # contract (d): segment starts must be tile-aligned (the attention op
+    # writes each tile's KV as one block dynamic-update-slice)
+    with pytest.raises(ValueError, match="aligned"):
+        PrefillBatchConfig.build(
+            [(0, [1, 2, 3], 10)], [13], tile_size=4,
             max_tokens=16, max_requests=4,
         )
 
@@ -131,3 +138,34 @@ def test_request_manager_emits_prefill_batch_config():
     rm.process_result(res, points)
     bc2, _ = rm.prepare_next_batch()
     assert isinstance(bc2, BatchConfig)
+
+
+def test_mixed_decode_prefill_keeps_tile_alignment():
+    """Regression (r5 review): a mixed decode+prefill step must advance
+    prefill offsets by whole tiles, so the later pure-prefill steps can
+    take the tiled path — an unaligned offset used to crash the
+    PrefillBatchConfig builder once contract (d) landed."""
+    im = make_im(max_tokens=24, max_requests=2, max_seq=64, use_pallas=True)
+    tile = im.prefill_tile
+    assert 1 < tile < 24
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=6))
+    prompt_a = [(i % 11) + 1 for i in range(5)]
+    rm.register_new_request(prompt_a)
+    # run A through prefill into decoding
+    for _ in range(4):
+        bc, pts = rm.prepare_next_batch()
+        rm.process_result(im.step(bc), pts)
+        if rm._active() and rm._active()[0].generated:
+            break
+    # B arrives mid-decode: the next steps mix decode(A) + prefill(B)
+    prompt_b = [(i % 7) + 1 for i in range(30)]
+    rid_b = rm.register_new_request(prompt_b)
+    while rm.has_work():
+        bc, pts = rm.prepare_next_batch()
+        for req in rm._active():
+            if req.status is not None and req.prefill_offset < len(req.prompt):
+                assert req.prefill_offset % tile == 0 or \
+                    req.prefill_offset == 0
+        rm.process_result(im.step(bc), pts)
+    out_b = rm.requests[rid_b].generated
+    assert out_b == ref_greedy_decode(im.params, TINY, prompt_b, 6)
