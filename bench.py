@@ -80,7 +80,7 @@ def matmul_param_count(im):
 
 def build_im(use_pallas, layers, hidden, heads, kv, inter, vocab,
              max_requests, max_seq, max_tokens=None, max_spec=0, topk=0,
-             params=None):
+             params=None, seed=0):
     import jax
 
     from flexflow_tpu import FFConfig, FFModel
@@ -106,7 +106,7 @@ def build_im(use_pallas, layers, hidden, heads, kv, inter, vocab,
         max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
         outputs=logits, use_pallas=use_pallas,
     )
-    im.init_operators_inference(params=params, rng=jax.random.PRNGKey(0),
+    im.init_operators_inference(params=params, rng=jax.random.PRNGKey(seed),
                                 dtype="bfloat16")
     return im
 
@@ -280,7 +280,8 @@ def bench_ttft(ctx=1800, n_outer=3, cap=256,
     }
 
 
-def _gen_llm_trajectories(llm, rng, rounds=4, prefix=8, seq_len=64):
+def _gen_llm_trajectories(llm, rng, rounds=4, prefix=8, seq_len=49,
+                          vocab=32000):
     """Greedy LLM trajectories as distillation data: random ``prefix``-token
     prompts continued by the LLM itself.  Every transition after the prefix
     IS the LLM's argmax, so (token[t] -> token[t+1]) pairs are free labels —
@@ -292,7 +293,7 @@ def _gen_llm_trajectories(llm, rng, rounds=4, prefix=8, seq_len=64):
     seqs, masks = [], []
     for _ in range(rounds):
         llm.reset()
-        prompts = rng.randint(1, 31999, size=(R, prefix)).tolist()
+        prompts = rng.randint(1, vocab - 1, size=(R, prefix)).tolist()
         firsts = prefill_im(llm, prompts)
         bc = BatchConfig.build(
             firsts, list(range(R)), [prefix] * R, [prefix + 1] * R,
@@ -310,7 +311,58 @@ def _gen_llm_trajectories(llm, rng, rounds=4, prefix=8, seq_len=64):
     return np.asarray(seqs, np.int32), np.asarray(masks)
 
 
-def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=64,
+def _draft_logits(params, tokens2d, n_layers, kv, gq, d, theta, eps):
+    """Batched-causal forward over the 2-layer llama draft params.
+
+    The same math as the serve graph (mirrors tests/test_serve.py's
+    ``ref_llama_logits``, which is equality-tested against the serve stack),
+    vmapped over sequences.  Training runs through THIS — a [B, L] dense
+    program whose fwd+bwd compiles in seconds — instead of the serve
+    graph's flat-token KV-cache forward, whose backward once produced a
+    compile so large it broke the tunnel's remote-compile service.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.serve.ops import apply_rope
+
+    def one(toks):
+        x = params["model.embed_tokens"]["weight"][toks]
+        L = x.shape[0]
+        pos = jnp.arange(L)
+
+        def rms(h, g):
+            var = jnp.mean(h.astype(jnp.float32) ** 2, -1, keepdims=True)
+            return (h * jax.lax.rsqrt(var + eps) * g).astype(h.dtype)
+
+        for i in range(n_layers):
+            h = rms(x, params[f"model.layers.{i}.input_layernorm"]["gamma"])
+            p = params[f"model.layers.{i}.self_attn"]
+            qkvx = jnp.einsum("te,ekgd->tkgd", h, p["qkv"])
+            q, k, v = qkvx[:, :, :gq], qkvx[:, :, gq], qkvx[:, :, gq + 1]
+            q = apply_rope(q, pos, theta)
+            k = apply_rope(k, pos, theta)
+            sc = jnp.einsum("tkgd,skd->tkgs", q, k,
+                            preferred_element_type=jnp.float32) / np.sqrt(d)
+            mask = pos[None, :] <= pos[:, None]
+            sc = jnp.where(mask[:, None, None, :], sc, -1e30)
+            w = jax.nn.softmax(sc, -1)
+            att = jnp.einsum("tkgs,skd->tkgd", w, v.astype(w.dtype)
+                             ).reshape(L, -1).astype(x.dtype)
+            x = x + att @ p["o_proj"]
+            h = rms(x, params[f"model.layers.{i}.post_attention_layernorm"]
+                    ["gamma"])
+            gate = h @ params[f"model.layers.{i}.mlp.gate_proj"]["kernel"]
+            up = h @ params[f"model.layers.{i}.mlp.up_proj"]["kernel"]
+            x = x + (jax.nn.silu(gate) * up) @ params[
+                f"model.layers.{i}.mlp.down_proj"]["kernel"]
+        h = rms(x, params["model.norm"]["gamma"])
+        return h @ params["lm_head"]["kernel"]
+
+    return jax.vmap(one)(tokens2d)
+
+
+def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=49,
                  lr=3e-4):
     """Distill a 2-layer draft on the LLM's on-device greedy trajectories
     (VERDICT r4 #6).
@@ -325,20 +377,26 @@ def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=64,
     import jax.numpy as jnp
     import optax
 
-    from flexflow_tpu.serve.batch_config import BatchConfig
-
-    seqs, masks = _gen_llm_trajectories(llm, rng, seq_len=seq_len)
-    # free the LLM's KV buffers for the training phase; measure_at's
+    # seq_len=49 => trajectory continuation = 40 decode steps, the SAME
+    # scan length the decode bench compiles — the tunnel's remote-compile
+    # service has crashed twice under this section's big fresh compiles
+    # (broken pipe), so every device program here reuses a cached one
+    # except the (small) batched distillation scan itself
+    seqs, masks = _gen_llm_trajectories(llm, rng, seq_len=seq_len,
+                                        vocab=shape["vocab"])
+    # free the LLM's KV buffers for the training phase; the caller's
     # llm.reset() re-allocates them afterwards
     llm.state = None
     gc.collect()
-    # training IM: gather-path attention (differentiable), short cache
+    # param template for the random-init draft layers: a tiny 2-layer IM
+    # used ONLY for init (no step is ever compiled on it).  seed=1: with
+    # the default seed the per-node key folding would make the draft's
+    # layers BIT-IDENTICAL to the teacher's first two (same names, same
+    # graph order) — the init must be genuinely random, not weight sharing
     tr = build_im(use_pallas=False, layers=2, hidden=shape["hidden"],
                   heads=shape["heads"], kv=shape["kv"],
                   inter=shape["inter"], vocab=shape["vocab"],
-                  max_requests=batch_slots, max_seq=seq_len,
-                  max_tokens=batch_slots * seq_len)
-    tr.init_operators_inference(rng=jax.random.PRNGKey(1), dtype="bfloat16")
+                  max_requests=1, max_seq=8, max_tokens=8, seed=1)
     frozen = {}
     trainable = {}
     for name, g in tr.params.items():
@@ -347,54 +405,56 @@ def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=64,
                 lambda x: x.astype(jnp.float32), g)
         else:  # embed_tokens / final norm / lm_head: the LLM's, frozen
             frozen[name] = llm.params[name]
-    tid = tr._token_tid
-    state0 = tr.state  # zeros; the forward is functional, never mutated
-    t_flat = batch_slots * seq_len
-    req_idx = jnp.asarray(
-        np.repeat(np.arange(batch_slots), seq_len).astype(np.int32))
-    positions = jnp.asarray(
-        np.tile(np.arange(seq_len), batch_slots).astype(np.int32))
-    seq_lens = jnp.full((batch_slots,), seq_len, jnp.int32)
+    release_im(tr)
+    kv = shape["kv"]
+    gq = shape["heads"] // kv
+    d = shape["hidden"] // shape["heads"]
 
-    def loss_fn(tr_params, tokens, labels, mask):
-        params = dict(frozen)
+    def loss_fn(tr_params, frozen_, tokens, labels, mask):
+        params = dict(frozen_)
         params.update(tr_params)
-        outs, _ = tr._fwd(
-            params, {tid: tokens}, state=state0,
-            extras={"batch_config": BatchConfig(
-                tokens=tokens, request_index=req_idx,
-                token_position=positions,
-                num_tokens=jnp.asarray(t_flat, jnp.int32),
-                seq_lens=seq_lens,
-            ), "pallas_decode": False, "pallas_interpret": False,
-                "tree_layout": None},
-        )
-        lp = jax.nn.log_softmax(outs[0].astype(jnp.float32))
-        nll = -jnp.take_along_axis(lp, labels[:, None], axis=-1)[:, 0]
+        logits = _draft_logits(params, tokens, n_layers=2, kv=kv, gq=gq,
+                               d=d, theta=10000.0, eps=1e-6)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     opt = optax.adam(lr)
     opt_state = opt.init(trainable)
 
-    @jax.jit
-    def step(tr_params, opt_state, tokens, labels, mask):
-        loss, grads = jax.value_and_grad(loss_fn)(tr_params, tokens, labels,
-                                                  mask)
-        updates, opt_state = opt.update(grads, opt_state, tr_params)
-        return optax.apply_updates(tr_params, updates), opt_state, loss
-
+    # whole training run as ONE on-device lax.scan: a host-dispatched loop
+    # would pay ~300 tunnel round trips (minutes); this pays one compile +
+    # one sync (the same design rule as decode_scan/spec_scan)
+    seqs_d = jnp.asarray(seqs)
+    labels_d = jnp.asarray(
+        np.concatenate([seqs[:, 1:], np.zeros((len(seqs), 1), np.int32)],
+                       axis=1))
+    masks_d = jnp.asarray(masks.astype(np.float32))
     n = len(seqs)
-    order = np.random.RandomState(7)
-    for it in range(steps):
-        sel = order.randint(0, n, size=batch_slots)
-        toks = jnp.asarray(seqs[sel].reshape(-1))
-        labels = jnp.asarray(
-            np.concatenate([np.append(s[1:], 0) for s in seqs[sel]])
-            .astype(np.int32))
-        mask = jnp.asarray(
-            np.concatenate([masks[i] for i in sel]).astype(np.float32))
-        trainable, opt_state, loss = step(trainable, opt_state, toks,
-                                          labels, mask)
+
+    # frozen params and the trajectory arrays are ARGUMENTS, not closures:
+    # jit embeds closed-over arrays as HLO constants, and ~0.5 GB of
+    # embedded embedding/head weights in the serialized computation is what
+    # broke the tunnel's remote-compile service (broken pipe) twice
+    @jax.jit
+    def train_scan(tr_params, opt_state, frozen_, data, key):
+        seqs_a, labels_a, masks_a = data
+
+        def body(carry, k):
+            tr_params, opt_state = carry
+            sel = jax.random.randint(k, (batch_slots,), 0, n)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                tr_params, frozen_, seqs_a[sel], labels_a[sel], masks_a[sel])
+            updates, opt_state = opt.update(grads, opt_state, tr_params)
+            return (optax.apply_updates(tr_params, updates), opt_state), loss
+
+        (tr_params, opt_state), losses = jax.lax.scan(
+            body, (tr_params, opt_state), jax.random.split(key, steps))
+        return tr_params, losses[-1]
+
+    trainable, loss = train_scan(trainable, opt_state, frozen,
+                                 (seqs_d, labels_d, masks_d),
+                                 jax.random.PRNGKey(7))
     final_loss = float(loss)
     release_im(tr)
     del opt_state
@@ -403,6 +463,44 @@ def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=64,
     for name, g in trainable.items():
         params[name] = jax.tree.map(lambda x: x.astype(jnp.bfloat16), g)
     return params, final_loss
+
+
+def _measure_spec(sc, llm, ssm, prompts, plen, depth, n_lo=4, n_hi=20,
+                  n_outer=3):
+    """Shared spec-decode measurement: prefill both models, run two scan
+    lengths, slope out the dispatch latency, count committed tokens.
+    Used by the synthetic sweep AND the trained-draft point (one copy of
+    the estimator, per r5 review)."""
+    R = len(prompts)
+    llm.reset()
+    ssm.reset()
+    firsts = prefill_im(llm, prompts)
+    prefill_im(ssm, prompts)
+    carry = sc.init_carry(firsts, [plen] * R, [plen] * R, [False] * R)
+    committed = []
+
+    def best_of(n_macro, carry):
+        emitted, carry = sc.run(carry, n_macro)  # compile + warm
+        committed.append(np.asarray(emitted))
+        best = float("inf")
+        for _ in range(n_outer):
+            t0 = time.perf_counter()
+            emitted, carry = sc.run(carry, n_macro)
+            np.asarray(emitted)
+            best = min(best, time.perf_counter() - t0)
+        return best, carry
+
+    t_lo, carry = best_of(n_lo, carry)
+    t_hi, carry = best_of(n_hi, carry)
+    per_macro = (t_hi - t_lo) / (n_hi - n_lo)
+    em = np.concatenate([c.reshape(-1, R, depth + 1) for c in committed])
+    toks = float((em >= 0).sum()) / (em.shape[0] * R)
+    return {
+        "tpot_ms": round(per_macro / toks * 1e3, 3),
+        "macro_ms": round(per_macro * 1e3, 3),
+        "tokens_per_macro": round(toks, 3),
+        "acceptance": round((toks - 1.0) / depth, 3),
+    }
 
 
 def bench_spec_decode(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
@@ -452,65 +550,16 @@ def bench_spec_decode(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
     prompts = rng.randint(1, 31999, size=(R, ctx)).tolist()
     sc = SpecDecodeScan(llm, ssm, width=width, depth=depth)
 
-    def measure_at(scale, sc_=None, ssm_=None):
-        sc_ = sc_ or sc
-        ssm_ = ssm_ or ssm
+    def measure_at(scale):
         for i, (o, d) in pristine.items():
             llm.params[f"model.layers.{i}.self_attn"]["o_proj"] = o * scale
             llm.params[f"model.layers.{i}.mlp.down_proj"]["kernel"] = d * scale
-        llm.reset()
-        ssm_.reset()
-        firsts = prefill_im(llm, prompts)
-        prefill_im(ssm_, prompts)
-        carry = sc_.init_carry(firsts, [ctx] * R, [ctx] * R, [False] * R)
-        committed = []
-
-        def best_of(n_macro, carry):
-            emitted, carry = sc_.run(carry, n_macro)  # compile + warm
-            committed.append(np.asarray(emitted))
-            best = float("inf")
-            for _ in range(n_outer):
-                t0 = time.perf_counter()
-                emitted, carry = sc_.run(carry, n_macro)
-                np.asarray(emitted)
-                best = min(best, time.perf_counter() - t0)
-            return best, carry
-
-        t_lo, carry = best_of(n_lo, carry)
-        t_hi, carry = best_of(n_hi, carry)
-        per_macro = (t_hi - t_lo) / (n_hi - n_lo)
-        em = np.concatenate([c.reshape(-1, R, depth + 1) for c in committed])
-        toks = float((em >= 0).sum()) / (em.shape[0] * R)
-        return {
-            "tpot_ms": round(per_macro / toks * 1e3, 3),
-            "macro_ms": round(per_macro * 1e3, 3),
-            "tokens_per_macro": round(toks, 3),
-            "acceptance": round((toks - 1.0) / depth, 3),
-        }
+        return _measure_spec(sc, llm, ssm, prompts, ctx, depth,
+                             n_lo, n_hi, n_outer)
 
     points = {str(s): measure_at(s) for s in scales}
 
-    # trained-draft point (VERDICT r4 #6): a genuinely separate 2-layer
-    # draft, random init, distilled on the TRUE LLM's (scale=1.0) greedy
-    # trajectories on device — its acceptance is earned, not constructed
-    try:
-        release_im(ssm)  # synthetic draft done; free its KV buffers
-        for i, (o, d) in pristine.items():  # labels come from the true LLM
-            llm.params[f"model.layers.{i}.self_attn"]["o_proj"] = o
-            llm.params[f"model.layers.{i}.mlp.down_proj"]["kernel"] = d
-        trained_params, distill_loss = _train_draft(
-            llm, shape, np.random.RandomState(11), steps=300)
-        ssm_t = build_im(use_pallas=True, layers=2, max_requests=R,
-                         max_seq=max_seq, max_tokens=R * (depth + 1),
-                         max_spec=8, topk=max(width, 1),
-                         params=trained_params, **shape)
-        sc_t = SpecDecodeScan(llm, ssm_t, width=width, depth=depth)
-        points["trained"] = measure_at(1.0, sc_t, ssm_t)
-        points["trained"]["distill_loss"] = round(distill_loss, 3)
-        release_im(ssm_t)
-    except Exception as e:  # the sweep still reports without the point
-        points["trained"] = {"error": f"{type(e).__name__}: {e}"[:160]}
-
+    release_im(ssm)
     release_im(llm)  # later bench sections need the HBM (r5: the trained-
     # draft phase once left enough live to OOM bench_mlp_train)
     ceiling = points[str(scales[0])]
@@ -532,6 +581,75 @@ def bench_spec_decode(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
                        "Llama-2 text quality; device costs are real at "
                        "every point)",
     }
+
+
+def bench_spec_trained(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
+                       n_outer=3):
+    """Trained-draft speculation point (VERDICT r4 #6), as its own bench
+    section: a genuinely separate 2-layer draft (random-init decoder
+    layers, LLM's frozen embeddings/head) distilled ON DEVICE on the true
+    LLM's greedy trajectories, then measured through the same spec-decode
+    scan as the synthetic sweep.  Isolated from bench_spec_decode so a
+    contention stall in its (large) distillation compile can be deadline-
+    skipped without losing the synthetic sweep.
+
+    Returns a dict to merge under ``spec_points["trained"]``.
+    """
+    import jax
+
+    from flexflow_tpu.serve.spec_scan import SpecDecodeScan
+
+    R = 8
+    P = 1 + width * depth
+    max_seq = 2432
+    shape = dict(hidden=4096, heads=32, kv=32, inter=11008, vocab=32000)
+    llm = build_im(use_pallas=True, layers=8, max_requests=R,
+                   max_seq=max_seq, max_tokens=R * P, max_spec=8, **shape)
+    try:
+        trained_params, distill_loss = _train_draft(
+            llm, shape, np.random.RandomState(11), steps=600, lr=1e-3)
+        ssm_t = build_im(use_pallas=True, layers=2, max_requests=R,
+                         max_seq=max_seq, max_tokens=R * (depth + 1),
+                         max_spec=8, topk=max(width, 1),
+                         params=trained_params, **shape)
+        sc = SpecDecodeScan(llm, ssm_t, width=width, depth=depth)
+
+        def measure(pctx, seed=0):
+            rng = np.random.RandomState(seed)
+            prompts = rng.randint(1, 31999, size=(R, pctx)).tolist()
+            return _measure_spec(sc, llm, ssm_t, prompts, pctx, depth,
+                                 n_lo, n_hi, n_outer)
+
+        # three acceptance conditions, from honest to optimistic:
+        # * held-out bench context (the headline number),
+        # * held-out 8-token prompts (the training DISTRIBUTION),
+        # * the actual training prompts (seed 11 = _train_draft's rounds,
+        #   so the LLM regenerates the memorized trajectories — this
+        #   validates the full distill->serve loop at the 7B shape; with a
+        #   RANDOM-weight teacher the draft can only memorize, since the
+        #   teacher's function carries no learnable structure beyond its
+        #   32 sampled trajectories)
+        point = measure(ctx)
+        point["distill_loss"] = round(distill_loss, 3)
+        point["acceptance_heldout_prompts"] = measure(8)["acceptance"]
+        point["acceptance_train_prompts"] = measure(8, seed=11)["acceptance"]
+        point["trained_note"] = (
+            "random-init 2-layer decoder distilled on 32 on-device greedy "
+            "trajectories of the RANDOM-WEIGHT teacher (no real Llama "
+            "weights exist in this zero-egress env).  It memorizes them "
+            "(distill_loss ~0.01) yet even train-prompt acceptance stays "
+            "low: a random teacher's logit margins are knife-edge, so the "
+            "fp-ordering difference between the incremental path (which "
+            "generated the labels) and the tree-verify path flips the "
+            "teacher's own argmax — the synthetic sweep's CONSTRUCTED "
+            "perfect draft tops out at 0.975 for the same reason.  The "
+            "tiny-config CPU regression test (learnable teacher) shows the "
+            "pipeline earns real held-out acceptance; at 7B this point "
+            "measures the machinery + device costs, not draft quality")
+        release_im(ssm_t)
+        return point
+    finally:
+        release_im(llm)
 
 
 def bench_mlp_train(batch: int = 64):
@@ -742,44 +860,58 @@ def searched_vs_dp_fields():
 
 
 def main():
+    import os
+    import sys
+
     import jax
+
+    t_start = time.perf_counter()
+    # the shared/tunneled chip has contention episodes where a single AOT
+    # compile stalls for many minutes (observed r5); the driver records
+    # NOTHING if the process is killed mid-run, so every section after the
+    # headline is deadline-guarded and error-guarded — a partial JSON line
+    # always beats rc=124
+    deadline = float(os.environ.get("BENCH_DEADLINE_S", 2100))
+
+    def mark(section):
+        print(f"[bench +{time.perf_counter() - t_start:7.1f}s] {section}",
+              file=sys.stderr, flush=True)
+
+    def due():
+        return time.perf_counter() - t_start > deadline
+
+    doc = {}
+
+    def section(name, fn, device=True):
+        if device and due():
+            doc[f"{name}_skipped"] = "deadline"
+            mark(f"{name} SKIPPED (deadline)")
+            return
+        mark(name)
+        try:
+            fn()
+        except Exception as e:
+            doc[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+            mark(f"{name} ERROR: {type(e).__name__}")
 
     shape = dict(layers=8, hidden=4096, heads=32, kv=32, inter=11008,
                  vocab=32000, max_requests=8, max_seq=2048)
     ctx = 1800
+    n = shape["max_requests"]
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_HBM.get(kind)  # None on unknown hardware -> hbm_frac null
 
+    # headline (NOT skippable): the driver's metric line
+    mark("decode/pallas")
     im = build_im(use_pallas=True, **shape)
     pallas_tpot, pallas_tpot_med = bench_decode_scan(im, ctx, spread=True)
     bytes_per_step = step_bytes(im, ctx)
     release_im(im)
-
-    im = build_im(use_pallas=False, **shape)
-    gather_tpot = bench_decode_scan(im, ctx)
-    release_im(im)
-
-    # weight-only int8 decode (VERDICT r4 #8): decode is weight-bandwidth-
-    # bound, so halving the weight bytes is a direct TPOT lever — IF XLA
-    # fuses the dequant into the GEMM operand pipeline (measured here)
-    from flexflow_tpu.serve import quantize_int8
-
-    im = build_im(use_pallas=True, **shape)
-    n_q = quantize_int8(im)
-    int8_tpot = bench_decode_scan(im, ctx)
-    int8_bytes = step_bytes(im, ctx)
-    release_im(im)
-
-    ttft = bench_ttft(ctx=ctx)
-    spec = bench_spec_decode(ctx=ctx)
-
-    kind = jax.devices()[0].device_kind
-    peak = PEAK_HBM.get(kind)  # None on unknown hardware -> hbm_frac null
-    n = shape["max_requests"]
-    mlp = bench_mlp_train()
-    doc = {
+    doc.update({
         "metric": "serve_decode_throughput",
         "value": round(n / pallas_tpot, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(gather_tpot / pallas_tpot, 3),
+        "vs_baseline": None,  # filled by the gather section
         "tpot_ms": round(pallas_tpot * 1e3, 3),
         "tpot_ms_median": round(pallas_tpot_med * 1e3, 3),
         "tpot_note": "min over 6 paired slope estimates; the shared/tunneled "
@@ -787,11 +919,6 @@ def main():
                      "measurement), which fully covers the r2->r3 6.878->"
                      "7.407 delta VERDICT r3 flagged — same code, different "
                      "contention; median reported for the spread",
-        "gather_tpot_ms": round(gather_tpot * 1e3, 3),
-        "int8_tpot_ms": round(int8_tpot * 1e3, 3),
-        "int8_vs_bf16": round(pallas_tpot / int8_tpot, 3),
-        "int8_note": f"{n_q} weight arrays int8 (per-out-channel scales, "
-                     "dequant fused on chip); same decode scan as tpot_ms",
         # median-based (the min-TPOT estimator is biased ~5% fast, which
         # pushed the fraction above the physical ceiling; the median is the
         # conservative device-time basis)
@@ -799,28 +926,84 @@ def main():
         if peak else None,
         "hbm_frac_best": round(bytes_per_step / (pallas_tpot * peak), 3)
         if peak else None,
-        "int8_hbm_frac": round(int8_bytes / (int8_tpot * peak), 3)
-        if peak else None,
         "config": "llama2-7b-shape 8-layer slice, bf16, bs=8, ctx=1800",
         "device": kind,
-        "mnist_mlp_train_samples_per_sec": round(mlp, 1),
-        "mnist_timing_note": "on-device scan slope (device throughput); "
-                             "r01 measured async dispatch (wrong), r02 "
-                             "included ~1.4ms/step host dispatch",
-    }
-    doc.update(ttft)
-    doc.update(spec)
-    doc["spec_vs_incr"] = round(pallas_tpot * 1e3 / spec["spec_tpot_ms"], 3)
-    for p in doc["spec_points"].values():
-        p["vs_incr"] = round(pallas_tpot * 1e3 / p["tpot_ms"], 3)
-    # acceptance at which one macro-step (depth drafts + verify) costs the
-    # same per token as incremental decoding: macro/(1+a*d) = tpot
-    doc["spec_break_even_acceptance"] = round(
-        (spec["spec_macro_ms"] / (pallas_tpot * 1e3) - 1) / spec["spec_depth"],
-        3,
-    )
-    doc.update(bench_cost_model())
-    doc.update(searched_vs_dp_fields())
+    })
+
+    def do_ttft():
+        doc.update(bench_ttft(ctx=ctx))
+
+    def do_spec():
+        spec = bench_spec_decode(ctx=ctx)
+        doc.update(spec)
+        doc["spec_vs_incr"] = round(
+            pallas_tpot * 1e3 / spec["spec_tpot_ms"], 3)
+        for p in doc["spec_points"].values():
+            if "tpot_ms" in p:
+                p["vs_incr"] = round(pallas_tpot * 1e3 / p["tpot_ms"], 3)
+        # acceptance at which one macro-step (depth drafts + verify) costs
+        # the same per token as incremental decoding: macro/(1+a*d) = tpot
+        doc["spec_break_even_acceptance"] = round(
+            (spec["spec_macro_ms"] / (pallas_tpot * 1e3) - 1)
+            / spec["spec_depth"], 3)
+
+    def do_gather():
+        im = build_im(use_pallas=False, **shape)
+        gather_tpot = bench_decode_scan(im, ctx)
+        release_im(im)
+        doc["gather_tpot_ms"] = round(gather_tpot * 1e3, 3)
+        doc["vs_baseline"] = round(gather_tpot / pallas_tpot, 3)
+
+    def do_int8():
+        # weight-only int8 decode (VERDICT r4 #8): decode is weight-
+        # bandwidth-bound, so halving the weight bytes is a direct TPOT
+        # lever — IF XLA fuses the dequant into the GEMM operand pipeline
+        from flexflow_tpu.serve import quantize_int8
+
+        im = build_im(use_pallas=True, **shape)
+        n_q = quantize_int8(im)
+        int8_tpot = bench_decode_scan(im, ctx)
+        int8_bytes = step_bytes(im, ctx)
+        release_im(im)
+        doc["int8_tpot_ms"] = round(int8_tpot * 1e3, 3)
+        doc["int8_vs_bf16"] = round(pallas_tpot / int8_tpot, 3)
+        doc["int8_hbm_frac"] = (round(int8_bytes / (int8_tpot * peak), 3)
+                                if peak else None)
+        doc["int8_note"] = (f"{n_q} weight arrays int8 (per-out-channel "
+                            "scales, dequant fused on chip); same decode "
+                            "scan as tpot_ms")
+
+    def do_spec_trained():
+        point = bench_spec_trained(ctx=ctx)
+        if "tpot_ms" in point:
+            point["vs_incr"] = round(pallas_tpot * 1e3 / point["tpot_ms"], 3)
+        doc.setdefault("spec_points", {})["trained"] = point
+
+    def do_mnist():
+        doc["mnist_mlp_train_samples_per_sec"] = round(bench_mlp_train(), 1)
+        doc["mnist_timing_note"] = (
+            "on-device scan slope (device throughput); r01 measured async "
+            "dispatch (wrong), r02 included ~1.4ms/step host dispatch")
+
+    def do_cost_model():
+        doc.update(bench_cost_model())
+
+    def do_searched():
+        doc.update(searched_vs_dp_fields())
+
+    # north-star artifacts first, cheaper context later; the CPU-only
+    # search section runs even past the device deadline, and the two
+    # largest fresh-compile sections (int8, trained draft) go LAST so a
+    # contention stall there costs only themselves
+    section("ttft", do_ttft)
+    section("spec", do_spec)
+    section("decode/gather", do_gather)
+    section("mnist", do_mnist)
+    section("cost_model", do_cost_model)
+    section("searched_vs_dp", do_searched, device=False)
+    section("decode/int8", do_int8)
+    section("spec_trained", do_spec_trained)
+    mark("done")
     print(json.dumps(doc))
 
 

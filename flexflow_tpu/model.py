@@ -771,6 +771,9 @@ class FFModel:
     def _setup_pipeline_training(self, cfg, mesh):
         """Replace the GSPMD train step with the GPipe executor.
 
+        Multi-output (list) losses are rejected here: the GPipe executor
+        drives a single suffix output through ``pl_loss``.
+
         Core-stage params restack to ``[K, ...]`` leaves sharded over the pp
         axis (memory divides across stages, the point of the pipeline);
         ``self.params`` holds them under the ``"_pp_core"`` group with
@@ -783,6 +786,11 @@ class FFModel:
         from .core.op import OpContext
         from .parallel.pipeline import graph_pipeline_train_step
 
+        if isinstance(self.loss_type, (list, tuple)):
+            raise ValueError(
+                "multi-output (list) losses are not supported with pipeline "
+                "parallelism — use a single loss or pipeline='off'"
+            )
         carve = self._pipeline_ctx[1]
         k, n_micro = carve["k"], carve["n_micro"]
         core = carve["core"]          # [K][U] nodes

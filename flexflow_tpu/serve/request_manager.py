@@ -214,10 +214,14 @@ class RequestManager:
             remaining = len(req.prompt) - req.prefill_offset
             if remaining <= budget:
                 take = remaining
-            else:
-                take = (budget // tile) * tile if tile > 1 else budget
+            elif tile > 1 and self.im.use_pallas:
+                # only the Pallas tiled path consumes the alignment; the
+                # gather path must not stall prefill for it
+                take = (budget // tile) * tile
                 if take == 0:
                     continue  # budget < one tile: keep alignment, wait
+            else:
+                take = budget
             start = req.prefill_offset
             for j in range(take):
                 tokens.append(req.prompt[start + j])
