@@ -311,7 +311,7 @@ def _gen_llm_trajectories(llm, rng, rounds=4, prefix=8, seq_len=49,
     return np.asarray(seqs, np.int32), np.asarray(masks)
 
 
-def _draft_logits(params, tokens2d, n_layers, kv, gq, d, theta, eps):
+def _draft_logits(params, tokens2d, n_layers, gq, d, theta, eps):
     """Batched-causal forward over the 2-layer llama draft params.
 
     The same math as the serve graph (mirrors tests/test_serve.py's
@@ -406,14 +406,13 @@ def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=49,
         else:  # embed_tokens / final norm / lm_head: the LLM's, frozen
             frozen[name] = llm.params[name]
     release_im(tr)
-    kv = shape["kv"]
-    gq = shape["heads"] // kv
+    gq = shape["heads"] // shape["kv"]
     d = shape["hidden"] // shape["heads"]
 
     def loss_fn(tr_params, frozen_, tokens, labels, mask):
         params = dict(frozen_)
         params.update(tr_params)
-        logits = _draft_logits(params, tokens, n_layers=2, kv=kv, gq=gq,
+        logits = _draft_logits(params, tokens, n_layers=2, gq=gq,
                                d=d, theta=10000.0, eps=1e-6)
         lp = jax.nn.log_softmax(logits.astype(jnp.float32))
         nll = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
@@ -456,7 +455,6 @@ def _train_draft(llm, shape, rng, steps=300, batch_slots=4, seq_len=49,
                                  (seqs_d, labels_d, masks_d),
                                  jax.random.PRNGKey(7))
     final_loss = float(loss)
-    release_im(tr)
     del opt_state
     gc.collect()
     params = dict(frozen)
@@ -595,8 +593,6 @@ def bench_spec_trained(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
 
     Returns a dict to merge under ``spec_points["trained"]``.
     """
-    import jax
-
     from flexflow_tpu.serve.spec_scan import SpecDecodeScan
 
     R = 8
@@ -614,14 +610,28 @@ def bench_spec_trained(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
                          params=trained_params, **shape)
         sc = SpecDecodeScan(llm, ssm_t, width=width, depth=depth)
 
-        def measure(pctx, seed=0):
+        def acceptance_only(pctx, seed):
+            # one warm scan at the already-compiled n_lo length — the
+            # auxiliary conditions only need the acceptance COUNT, not the
+            # 96-timed-macro-step timing protocol
             rng = np.random.RandomState(seed)
             prompts = rng.randint(1, 31999, size=(R, pctx)).tolist()
-            return _measure_spec(sc, llm, ssm_t, prompts, pctx, depth,
-                                 n_lo, n_hi, n_outer)
+            llm.reset()
+            ssm_t.reset()
+            firsts = prefill_im(llm, prompts)
+            prefill_im(ssm_t, prompts)
+            carry = sc.init_carry(firsts, [pctx] * R, [pctx] * R,
+                                  [False] * R)
+            ems = []
+            for _ in range(3):
+                emitted, carry = sc.run(carry, n_lo)
+                ems.append(np.asarray(emitted))
+            em = np.concatenate([e.reshape(-1, R, depth + 1) for e in ems])
+            toks = float((em >= 0).sum()) / (em.shape[0] * R)
+            return round((toks - 1.0) / depth, 3)
 
         # three acceptance conditions, from honest to optimistic:
-        # * held-out bench context (the headline number),
+        # * held-out bench context (the headline number, full timing),
         # * held-out 8-token prompts (the training DISTRIBUTION),
         # * the actual training prompts (seed 11 = _train_draft's rounds,
         #   so the LLM regenerates the memorized trajectories — this
@@ -629,10 +639,13 @@ def bench_spec_trained(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
         #   RANDOM-weight teacher the draft can only memorize, since the
         #   teacher's function carries no learnable structure beyond its
         #   32 sampled trajectories)
-        point = measure(ctx)
+        rng = np.random.RandomState(0)
+        prompts = rng.randint(1, 31999, size=(R, ctx)).tolist()
+        point = _measure_spec(sc, llm, ssm_t, prompts, ctx, depth,
+                              n_lo, n_hi, n_outer)
         point["distill_loss"] = round(distill_loss, 3)
-        point["acceptance_heldout_prompts"] = measure(8)["acceptance"]
-        point["acceptance_train_prompts"] = measure(8, seed=11)["acceptance"]
+        point["acceptance_heldout_prompts"] = acceptance_only(8, seed=0)
+        point["acceptance_train_prompts"] = acceptance_only(8, seed=11)
         point["trained_note"] = (
             "random-init 2-layer decoder distilled on 32 on-device greedy "
             "trajectories of the RANDOM-WEIGHT teacher (no real Llama "
@@ -931,7 +944,10 @@ def main():
     })
 
     def do_ttft():
-        doc.update(bench_ttft(ctx=ctx))
+        # cap=512: chunk-cap sweep (r5) measured 256/512/1024 at 21.0k /
+        # 25.7k / 25.8k prefill tok/s (39%/47%/47% MFU) — bigger chunks
+        # amortize per-chunk weight streaming; 512 takes nearly all of it
+        doc.update(bench_ttft(ctx=ctx, cap=512))
 
     def do_spec():
         spec = bench_spec_decode(ctx=ctx)

@@ -133,7 +133,7 @@ def test_bench_draft_forward_matches_reference():
     toks = np.asarray([[3, 11, 25, 40, 7, 1], [2, 2, 9, 30, 4, 5]], np.int32)
     got = bench._draft_logits(
         im.params, jnp.asarray(toks), n_layers=2,
-        kv=TINY.kv_heads, gq=TINY.num_attention_heads // TINY.kv_heads,
+        gq=TINY.num_attention_heads // TINY.kv_heads,
         d=TINY.hdim, theta=TINY.rope_theta, eps=TINY.rms_norm_eps)
     for b in range(2):
         want = ref_llama_logits(im.params, TINY, toks[b].tolist())
