@@ -31,6 +31,10 @@ def main():
     ap.add_argument("--max-requests", type=int, default=4)
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--kv-dtype", default=None, choices=["int8"],
+                    help="KV-cache storage dtype (int8: quantize-on-write "
+                         "caches with dequant fused into the Pallas "
+                         "attention kernels)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -68,6 +72,7 @@ def main():
         max_tokens_per_batch=args.max_tokens,
         max_seq_len=args.max_seq,
         outputs=logits,
+        kv_dtype=args.kv_dtype,
     )
     im.init_operators_inference(rng=jax.random.PRNGKey(0))
     rm = RequestManager(im, GenerationConfig(max_new_tokens=args.max_new_tokens))

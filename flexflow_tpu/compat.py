@@ -1,0 +1,20 @@
+"""Version-compatibility shims for the supported JAX range.
+
+No internal imports here (this module sits below everything else).
+"""
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` left ``jax.experimental`` in newer JAX and renamed
+    its replication-check kwarg (``check_rep`` -> ``check_vma``); dispatch
+    on what the installed JAX provides.  The check stays off either way:
+    the mapped bodies use explicit collectives whose replication the
+    checker can't always infer.
+    """
+    try:
+        from jax import shard_map as sm
+        kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover - depends on installed jax
+        from jax.experimental.shard_map import shard_map as sm
+        kw = {"check_rep": False}
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

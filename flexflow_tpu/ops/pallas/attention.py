@@ -23,6 +23,13 @@ Design (v2 — measured on a real v5e chip):
   and this alone is worth ~2x at half-full caches.
 * online softmax in f32; optional ALiBi bias (slopes passed in) so MPT-style
   models ride the same kernel.
+* **fused int8-KV dequant**: when the cache is int8 with per-(row, head,
+  position) f32 scales (``serve/ops.py`` quantize-on-write), the kernels take
+  ``k_scale``/``v_scale`` operands ``[rows, KV, S]`` streamed in the same
+  blocks as K/V and fold the dequant into the contractions — scores multiply
+  by the key's scale after the Q·K dot, attention weights multiply by the
+  value's scale before the P·V dot — so int8 KV never materializes as bf16
+  in HBM; only int8 bytes (+ 4-byte scales per 2*D-byte vector pair) move.
 
 Under tensor parallelism the caller (serve/ops.py) wraps these kernels in a
 ``shard_map`` over the kv-head axis — the cache's head dim is the shard dim,
@@ -47,24 +54,68 @@ NEG_INF = -1e30
 _VMEM_BUDGET = 8 * 2**20
 
 
+def _fit_block_s(block_s, s_len, num_kv, d, itemsize, kv_quant, budget):
+    """Largest seq-block that keeps the double-buffered K+V (+ scale)
+    pipeline under ``budget`` bytes and DIVIDES the cache seq length.
+
+    block_s must divide s_len: for a short tail block Pallas clamps the
+    block start (dynamic-slice semantics), so the kernel would read keys
+    shifted from where ``base`` says they are — the causal mask can't fix
+    aliased positions.  gcd keeps a dividing power-of-two when possible.
+    """
+    # bytes per cached position: K + V vectors, plus their two f32 scales
+    # when the cache is int8 (fused-dequant operands ride the same pipeline)
+    pos_bytes = 2 * num_kv * d * itemsize + (2 * num_kv * 4 if kv_quant else 0)
+    while block_s > 128 and 2 * block_s * pos_bytes > budget:
+        block_s //= 2
+    block_s = min(block_s, s_len)
+    if s_len % block_s:
+        block_s = math.gcd(block_s, s_len)
+    return block_s
+
+
+def _scale_plumbing(kv_map, num_kv, block_s, k_scale, v_scale):
+    """BlockSpecs + operands for the int8-KV dequant scales (one shared
+    construction for all three kernels).
+
+    The [rows, KV, S] scale buffers stream in the same blocks as the K/V
+    caches they describe, so their index map is the kernel's ``kv_map``
+    minus its trailing head-dim coordinate — deriving it here keeps the
+    causal-clamp logic in exactly one place per kernel.  Returns
+    ``([], ())`` for fp caches (no scale operands).
+    """
+    if k_scale is None:
+        return [], ()
+
+    def scale_map(*args):
+        return kv_map(*args)[:3]
+
+    specs = [
+        pl.BlockSpec((1, num_kv, block_s), scale_map, memory_space=pltpu.VMEM)
+    ] * 2
+    return specs, (k_scale.astype(jnp.float32), v_scale.astype(jnp.float32))
+
+
 def _decode_kernel(
     rows_ref,       # scalar prefetch: i32[T] cache row per token
     pos_ref,        # scalar prefetch: i32[T] absolute position per token
     q_ref,          # [1, KV, gq, D] this token's queries (kv-major)
     k_ref,          # [1, KV, Bs, D] cache K block (row rows[t], block s)
     v_ref,          # [1, KV, Bs, D]
-    slopes_ref,     # [KV, gq] alibi slopes (zeros when unused)
-    o_ref,          # [1, KV, gq, D] output
-    m_ref,          # VMEM scratch [KV, gq, 128] running max (lane-replicated)
-    l_ref,          # VMEM scratch [KV, gq, 128] running denom
-    acc_ref,        # VMEM scratch [KV, gq, D] running numerator
-    *,
+    *rest,          # [ks_ref, vs_ref,] slopes_ref, o_ref, m/l/acc scratch
     block_s: int,
     num_kv: int,
     gq: int,
     scale: float,
     use_alibi: bool,
+    kv_quant: bool,
 ):
+    if kv_quant:
+        # ks/vs: [1, KV, Bs] f32 per-position dequant scales, same block
+        # index map as K/V
+        ks_ref, vs_ref, slopes_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        slopes_ref, o_ref, m_ref, l_ref, acc_ref = rest
     t = pl.program_id(0)
     s = pl.program_id(1)
     last_s = pl.num_programs(1) - 1
@@ -86,6 +137,9 @@ def _decode_kernel(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                       # [KV, gq, Bs]
+        if kv_quant:
+            # fused dequant: q·(k_int8*ks) == (q·k_int8)*ks per key position
+            sc = sc * ks_ref[0][:, None, :]
 
         key_pos = base + jax.lax.broadcasted_iota(
             jnp.int32, (num_kv, gq, block_s), 2
@@ -107,7 +161,10 @@ def _decode_kernel(
         l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)                # [KV, Bs, D]
         pv = jax.lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))),
+            # fused dequant: (p*vs)·v_int8 == p·(v_int8*vs); the softmax
+            # denominator above uses the UNSCALED p
+            p * vs_ref[0][:, None, :] if kv_quant else p,
+            v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                                               # [KV, gq, D]
         acc_ref[...] = acc_ref[...] * alpha + pv
@@ -135,22 +192,17 @@ def decode_attention(
     block_s: int = 512,
     use_alibi: bool = False,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8-KV dequant
+    v_scale: Optional[jax.Array] = None,  # scales (None = fp cache)
 ) -> jax.Array:
     t, qh, d = q.shape
     _, num_kv, s_len, _ = k_cache.shape
     gq = qh // num_kv
-    itemsize = jnp.dtype(k_cache.dtype).itemsize
-    # cap the block so K+V double-buffered blocks fit the VMEM budget
-    while (block_s > 128
-           and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET):
-        block_s //= 2
-    block_s = min(block_s, s_len)
-    # block_s must DIVIDE s_len: for a short tail block Pallas clamps the
-    # block start (dynamic-slice semantics), so the kernel would read keys
-    # shifted from where `base` says they are — the causal mask can't fix
-    # aliased positions.  gcd keeps a dividing power-of-two when possible.
-    if s_len % block_s:
-        block_s = math.gcd(block_s, s_len)
+    kv_quant = k_scale is not None
+    # cap the block so K+V (+ scale) double-buffered blocks fit the budget
+    block_s = _fit_block_s(block_s, s_len, num_kv, d,
+                           jnp.dtype(k_cache.dtype).itemsize, kv_quant,
+                           _VMEM_BUDGET)
     n_blocks = s_len // block_s
     qr = q.reshape(t, num_kv, gq, d)
     if slopes is None:
@@ -162,6 +214,8 @@ def decode_attention(
         # block, whose copy Pallas then skips (same index as previous step)
         return (rows[i], 0, jnp.minimum(j, pos[i] // block_s), 0)
 
+    scale_specs, scale_args = _scale_plumbing(
+        kv_map, num_kv, block_s, k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(t, n_blocks),
@@ -176,6 +230,7 @@ def decode_attention(
             pl.BlockSpec(
                 (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
+            *scale_specs,
             pl.BlockSpec(
                 (num_kv, gq), lambda i, j, rows, pos: (0, 0),
                 memory_space=pltpu.VMEM,
@@ -194,7 +249,7 @@ def decode_attention(
     kernel = functools.partial(
         _decode_kernel,
         block_s=block_s, num_kv=num_kv, gq=gq,
-        scale=float(scale), use_alibi=use_alibi,
+        scale=float(scale), use_alibi=use_alibi, kv_quant=kv_quant,
     )
     out = pl.pallas_call(
         kernel,
@@ -202,7 +257,7 @@ def decode_attention(
         out_shape=jax.ShapeDtypeStruct((t, num_kv, gq, d), q.dtype),
         interpret=interpret,
     )(rows.astype(jnp.int32), positions.astype(jnp.int32),
-      qr, k_cache, v_cache, slopes)
+      qr, k_cache, v_cache, *scale_args, slopes)
     return out.reshape(t, qh, d)
 
 
@@ -219,17 +274,18 @@ def _prefill_kernel(
     q_ref,          # [1, KV, M, D] tile queries, M = Bq*gq (b-major fold)
     k_ref,          # [1, KV, Bs, D] cache K block (row rows[g], block s)
     v_ref,          # [1, KV, Bs, D]
-    o_ref,          # [1, KV, M, D]
-    m_ref,          # VMEM scratch [KV, M, 128]
-    l_ref,          # VMEM scratch [KV, M, 128]
-    acc_ref,        # VMEM scratch [KV, M, D]
-    *,
+    *rest,          # [ks_ref, vs_ref,] o_ref, m/l/acc scratch
     block_s: int,
     num_kv: int,
     gq: int,
     m_rows: int,
     scale: float,
+    kv_quant: bool,
 ):
+    if kv_quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     g = pl.program_id(0)
     s = pl.program_id(1)
     last_s = pl.num_programs(1) - 1
@@ -252,6 +308,8 @@ def _prefill_kernel(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                       # [KV, M, Bs]
+        if kv_quant:  # fused dequant (see _decode_kernel)
+            sc = sc * ks_ref[0][:, None, :]
 
         # per-row causal mask, reconstructed from the tile's start position:
         # query row r (= b*gq + g') sits at absolute position pstart + b
@@ -271,7 +329,8 @@ def _prefill_kernel(
         l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)                # [KV, Bs, D]
         pv = jax.lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))),
+            p * vs_ref[0][:, None, :] if kv_quant else p,
+            v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                                               # [KV, M, D]
         acc_ref[...] = acc_ref[...] * alpha + pv
@@ -296,6 +355,8 @@ def prefill_attention(
     scale: float,
     block_s: int = 512,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8-KV dequant
+    v_scale: Optional[jax.Array] = None,  # scales (None = fp cache)
 ) -> jax.Array:
     """Q-tiled prefill attention (the prompt phase of the reference's IncMHA).
 
@@ -313,13 +374,10 @@ def prefill_attention(
     _, num_kv, s_len, _ = k_cache.shape
     gq = qh // num_kv
     m_rows = bq * gq
-    itemsize = jnp.dtype(k_cache.dtype).itemsize
-    while (block_s > 128
-           and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET_PREFILL):
-        block_s //= 2
-    block_s = min(block_s, s_len)
-    if s_len % block_s:  # see decode_attention: tail blocks alias positions
-        block_s = math.gcd(block_s, s_len)
+    kv_quant = k_scale is not None
+    block_s = _fit_block_s(block_s, s_len, num_kv, d,
+                           jnp.dtype(k_cache.dtype).itemsize, kv_quant,
+                           _VMEM_BUDGET_PREFILL)
     n_blocks = s_len // block_s
     # fold tiles into the query-group dim, b-major: row = b*gq + g'
     qr = q.reshape(g, bq, num_kv, gq, d).transpose(0, 2, 1, 3, 4) \
@@ -329,6 +387,8 @@ def prefill_attention(
     def kv_map(i, j, rows, pstart, fmax):
         return (rows[i], 0, jnp.minimum(j, fmax[i] // block_s), 0)
 
+    scale_specs, scale_args = _scale_plumbing(
+        kv_map, num_kv, block_s, k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(g, n_blocks),
@@ -344,6 +404,7 @@ def prefill_attention(
             pl.BlockSpec(
                 (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
+            *scale_specs,
         ],
         out_specs=pl.BlockSpec(
             (1, num_kv, m_rows, d),
@@ -359,7 +420,7 @@ def prefill_attention(
     kernel = functools.partial(
         _prefill_kernel,
         block_s=block_s, num_kv=num_kv, gq=gq, m_rows=m_rows,
-        scale=float(scale),
+        scale=float(scale), kv_quant=kv_quant,
     )
     out = pl.pallas_call(
         kernel,
@@ -367,7 +428,7 @@ def prefill_attention(
         out_shape=jax.ShapeDtypeStruct((g, num_kv, m_rows, d), q.dtype),
         interpret=interpret,
     )(rows.astype(jnp.int32), pstart.astype(jnp.int32), fmax,
-      qr, k_cache, v_cache)
+      qr, k_cache, v_cache, *scale_args)
     return out.reshape(g, num_kv, bq, gq, d).transpose(0, 2, 1, 3, 4) \
         .reshape(g, bq, qh, d)
 
@@ -378,19 +439,20 @@ def _tree_kernel(
     q_ref,          # [1, KV, gq, D] this token's queries
     k_ref,          # [1, KV, Bs, D] committed-cache K block
     v_ref,          # [1, KV, Bs, D]
-    sk_ref,         # [1, KV, P, D] spec-buffer K row (whole tree)
-    sv_ref,         # [1, KV, P, D]
-    bias_ref,       # [1, 1, P] f32 ancestor bias (0 = ancestor, NEG_INF = not)
-    o_ref,          # [1, KV, gq, D]
-    m_ref,          # VMEM scratch [KV, gq, 128]
-    l_ref,          # VMEM scratch [KV, gq, 128]
-    acc_ref,        # VMEM scratch [KV, gq, D]
-    *,
+    *rest,          # [ks_ref, vs_ref,] sk_ref, sv_ref, bias_ref, o_ref,
+                    # m/l/acc scratch — scale blocks only for int8 committed
+                    # caches (the spec buffer stays in the compute dtype)
     block_s: int,
     num_kv: int,
     gq: int,
     scale: float,
+    kv_quant: bool,
 ):
+    if kv_quant:
+        ks_ref, vs_ref, sk_ref, sv_ref, bias_ref, o_ref, \
+            m_ref, l_ref, acc_ref = rest
+    else:
+        sk_ref, sv_ref, bias_ref, o_ref, m_ref, l_ref, acc_ref = rest
     t = pl.program_id(0)
     s = pl.program_id(1)
     last_s = pl.num_programs(1) - 1
@@ -412,6 +474,8 @@ def _tree_kernel(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                       # [KV, gq, Bs]
+        if kv_quant:  # fused dequant (see _decode_kernel)
+            sc = sc * ks_ref[0][:, None, :]
         key_pos = base + jax.lax.broadcasted_iota(
             jnp.int32, (num_kv, gq, block_s), 2
         )
@@ -425,7 +489,8 @@ def _tree_kernel(
         l_new = alpha * l_ref[:, :, 0:1] + jnp.sum(p, -1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
         pv = jax.lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))),
+            p * vs_ref[0][:, None, :] if kv_quant else p,
+            v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         acc_ref[...] = acc_ref[...] * alpha + pv
@@ -474,7 +539,7 @@ def _tree_kernel(
 
 
 def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
-               scale, block_s, interpret):
+               scale, block_s, interpret, k_scale=None, v_scale=None):
     """Shared pallas_call for the tree kernel.
 
     ``qr``: [N, KV, G, D] query groups (N grid rows share one cache row);
@@ -484,13 +549,10 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
     s_len = k_cache.shape[2]
     p_len = k_spec.shape[2]
     pp = bias.shape[-1]
-    itemsize = jnp.dtype(k_cache.dtype).itemsize
-    while (block_s > 128
-           and 4 * num_kv * block_s * d * itemsize > _VMEM_BUDGET):
-        block_s //= 2
-    block_s = min(block_s, s_len)
-    if s_len % block_s:  # see decode_attention: tail blocks alias positions
-        block_s = math.gcd(block_s, s_len)
+    kv_quant = k_scale is not None
+    block_s = _fit_block_s(block_s, s_len, num_kv, d,
+                           jnp.dtype(k_cache.dtype).itemsize, kv_quant,
+                           _VMEM_BUDGET)
     n_blocks = s_len // block_s
 
     def kv_map(i, j, rows, clens):
@@ -502,6 +564,8 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
     def spec_map(i, j, rows, clens):
         return (rows[i], 0, 0, 0)
 
+    scale_specs, scale_args = _scale_plumbing(
+        kv_map, num_kv, block_s, k_scale, v_scale)
     gb = bias.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -517,6 +581,7 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
             pl.BlockSpec(
                 (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
+            *scale_specs,
             pl.BlockSpec(
                 (1, num_kv, p_len, d), spec_map, memory_space=pltpu.VMEM,
             ),
@@ -541,6 +606,7 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
     kernel = functools.partial(
         _tree_kernel,
         block_s=block_s, num_kv=num_kv, gq=g, scale=float(scale),
+        kv_quant=kv_quant,
     )
     return pl.pallas_call(
         kernel,
@@ -548,7 +614,7 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
         out_shape=jax.ShapeDtypeStruct((n, num_kv, g, d), qr.dtype),
         interpret=interpret,
     )(rows.astype(jnp.int32), jnp.clip(clens, 0, s_len).astype(jnp.int32),
-      qr, k_cache, v_cache, k_spec, v_spec, bias)
+      qr, k_cache, v_cache, *scale_args, k_spec, v_spec, bias)
 
 
 def _pad_bias(amask):
@@ -576,6 +642,8 @@ def tree_attention(
     scale: float,
     block_s: int = 512,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8 committed-cache
+    v_scale: Optional[jax.Array] = None,  # dequant scales (None = fp cache)
 ) -> jax.Array:
     """Two-segment tree-verify attention (SpecInfer's TreeIncMHA hot loop).
 
@@ -598,7 +666,7 @@ def tree_attention(
     qr = q.reshape(t, num_kv, gq, d)
     bias = _pad_bias(amask)[:, None, :]  # [T, 1, Pp]
     out = _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens,
-                     bias, scale, block_s, interpret)
+                     bias, scale, block_s, interpret, k_scale, v_scale)
     return out.reshape(t, qh, d)
 
 
@@ -617,6 +685,8 @@ def tree_attention_batched(
     scale: float,
     block_s: int = 512,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8 committed-cache
+    v_scale: Optional[jax.Array] = None,  # dequant scales (None = fp cache)
 ) -> jax.Array:
     """Tree-verify attention for a FIXED [requests x tree-slots] layout.
 
@@ -636,6 +706,6 @@ def tree_attention_batched(
     # per-(slot, group) bias rows: [R, P, Pp] -> repeat gq -> [R, P*gq, Pp]
     bias = jnp.repeat(_pad_bias(amask), gq, axis=1)
     out = _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens,
-                     bias, scale, block_s, interpret)
+                     bias, scale, block_s, interpret, k_scale, v_scale)
     return out.reshape(r, num_kv, p, gq, d).transpose(0, 2, 1, 3, 4) \
         .reshape(r, p, qh, d)

@@ -177,14 +177,28 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
     params = 0.0
     acts = []
     state = 0.0
+    # weight matrices replaced by serve int8 quantization (serve/quant.py
+    # quantize_int8 / annotate_int8 set ``op.quantization = "int8"``): count
+    # 1 byte/element plus the per-out-channel f32 scale instead of the
+    # ParamSpec dtype — this is what makes the full-depth 7B-shape serve
+    # config (int8 weights + int8 KV) admissible within one chip's HBM.
+    _INT8_PARAM_NAMES = ("kernel", "qkv", "o_proj")
     for step in plan.steps:
         if step.is_parallel:
             continue
         pshs = plan.param_shardings.get(step.node.name, {})
+        q8 = getattr(step.node.op, "quantization", None) == "int8"
         for p in step.node.op.params():
             sh = pshs.get(p.name)
             n = _local_size(p.spec, sh, mesh) if sh is not None else p.spec.size
-            b = n * (p.spec.nbytes() // max(p.spec.size, 1))
+            if (q8 and p.name in _INT8_PARAM_NAMES
+                    and len(p.spec.shape) >= 2):
+                # int8 values + f32 scales (one per output channel; the
+                # GLOBAL scale count — errs high under sharding, as this
+                # estimator must)
+                b = n + (p.spec.size // p.spec.shape[0]) * 4
+            else:
+                b = n * (p.spec.nbytes() // max(p.spec.size, 1))
             params += b * (4.0 if training and p.trainable else 1.0)
         for spec, sh in zip(step.out_specs, step.out_shardings):
             acts.append(

@@ -34,7 +34,7 @@ from .request_manager import (
 from .spec_infer import SpecInferManager
 from .api import LLM, SSM
 from .weights import convert_state_dict, load_hf_model, place_params
-from .quant import quantize_int8
+from .quant import annotate_int8, quantize_int8
 
 from . import models  # noqa: F401  (registers model builders)
 
@@ -58,6 +58,7 @@ __all__ = [
     "load_hf_model",
     "place_params",
     "quantize_int8",
+    "annotate_int8",
     "ServeModelConfig",
     "build_model",
     "MODEL_REGISTRY",

@@ -65,7 +65,13 @@ class LLM:
         spec_depth: int = 3,
         dtype=None,
         devices=None,
+        kv_dtype=None,
     ) -> "LLM":
+        """``kv_dtype="int8"`` stores the KV caches int8 with fused
+        in-kernel dequant (see ``InferenceManager``) — halves decode KV
+        bandwidth and doubles context/batch capacity per HBM byte, which is
+        what makes the full-depth Llama-2-7B shape (int8 weights via
+        ``quantize_int8`` + int8 KV) admissible on one 16 GB chip."""
         devices = devices if devices is not None else jax.devices()[:tp]
         mesh = make_mesh({"tp": tp}, devices)
         ff = FFModel(FFConfig(), mesh=mesh)
@@ -80,6 +86,7 @@ class LLM:
             max_spec_tokens=max_spec_tokens,
             topk=topk,
             outputs=logits,
+            kv_dtype=kv_dtype,
         )
         if self._sd is not None:
             params = convert_state_dict(self._sd, self.config, dtype or "float32")
@@ -102,6 +109,7 @@ class LLM:
                     topk=max(spec_width, 1),
                     devices=devices[:1],
                     tp=1,
+                    kv_dtype=kv_dtype,
                 )
             self.rm = SpecInferManager(
                 self.im, ssm.im, gen, width=spec_width, depth=spec_depth

@@ -146,12 +146,22 @@ class InferenceManager:
         topk: int = 0,
         outputs=None,
         use_pallas: str = "auto",
+        kv_dtype: Optional[str] = None,
     ):
         """``model`` is an FFModel whose graph was built by a serve builder.
 
         ``outputs``: the logits Tensor(s); defaults to the last node's last
         output (the LM head) — serve graphs can have dangling intermediate
         tensors (e.g. the unused residual sum of the final fused norm).
+
+        ``kv_dtype``: KV-cache storage dtype.  ``"int8"`` stores the
+        committed k/v caches as int8 with per-(row, head, position) f32
+        scales (quantize-on-write, dequant fused into the Pallas attention
+        kernels) — halving decode KV bandwidth vs bf16 and the capacity
+        term that gates full-depth models; None (default) keeps the model's
+        compute dtype.  Registered on the attention ops BEFORE planning, so
+        ``plan_memory_bytes`` / the serve search see the quantized cache
+        footprint.
         """
         self.model = model
         self.max_requests = max_requests
@@ -159,6 +169,15 @@ class InferenceManager:
         self.max_seq_len = max_seq_len
         self.max_spec_tokens = max_spec_tokens
         self.topk = topk
+        if kv_dtype not in (None, "int8"):
+            # no silent fp coercion: the caches follow the model's compute
+            # dtype unless quantized, so honoring e.g. a float32 request on
+            # a bf16 model would need a real mixed-precision cache path —
+            # refuse rather than hand back a dtype the caller didn't ask for
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                             "(expected None or 'int8'; fp caches always "
+                             "use the model's compute dtype)")
+        self.kv_dtype = kv_dtype
         mesh = model.mesh
         if tp_axes is None:
             tp_axes = ("tp",) if mesh is not None and "tp" in mesh.shape else ()
@@ -170,6 +189,7 @@ class InferenceManager:
                 node.op.cost_seq_len = max_seq_len
                 node.op.cost_max_requests = max_requests
                 node.op.cost_max_spec = max_spec_tokens
+                node.op.kv_dtype = kv_dtype
         if strategy == "search":
             strategy = searched_serve_strategy(model)
         elif strategy is None:
@@ -210,6 +230,16 @@ class InferenceManager:
         tile = 1
         while (tile < 64 and max_tokens_per_batch % (tile * 2) == 0):
             tile *= 2
+        # the tile must also divide max_seq_len (ADVICE r5 medium): the
+        # tiled-prefill block DUS assumes tile-aligned starts never clamp
+        # against the cache's seq capacity.  The allocated cache is padded
+        # to a 128 multiple (every power-of-two tile <= 64 divides that),
+        # but enforcing divisibility against the DECLARED max_seq_len keeps
+        # the contract independent of the padding detail — and keeps
+        # prompt-end tiles from straddling the declared capacity.  Shrink
+        # rather than raise: halving stays within the builder contract.
+        while tile > 1 and max_seq_len % tile:
+            tile //= 2
         self.prefill_tile = tile
         # fixed tree-token layout (rows, slots) registered by SpecDecodeScan
         # (one per InferenceManager); the layout is PASSED per step by the
@@ -257,12 +287,13 @@ class InferenceManager:
             )
             bufs = {}
             for name, (shape, dt, sh) in specs.items():
-                if name in ("k", "v"):
+                if name in ("k", "v", "k_scale", "v_scale"):
                     # round the seq dim up to a lane-width multiple so the
                     # Pallas kernels always get a dividing power-of-two
                     # block (gcd fallback would otherwise collapse to tiny
                     # blocks for odd max_seq_len); extra slots sit beyond
-                    # every mask
+                    # every mask.  The int8 scale buffers share the caches'
+                    # seq dim (dim 2), so they pad identically.
                     s_pad = -(-shape[2] // 128) * 128
                     shape = shape[:2] + (s_pad,) + shape[3:]
                 arr = jnp.zeros(shape, jnp.dtype(dt))

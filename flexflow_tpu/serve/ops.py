@@ -100,6 +100,18 @@ class IncMultiHeadSelfAttention(Op):
     type_name = "inc_multihead_self_attention"
     stateful = True
 
+    # KV-cache storage dtype override, registered by the InferenceManager
+    # (``kv_dtype="int8"``): the committed k/v caches store int8 with
+    # per-(row, head, position) f32 scales in sibling ``k_scale``/``v_scale``
+    # buffers — quantize-on-write in the KV-update paths, dequant FUSED into
+    # the Pallas kernels' score/value contractions (never a bf16 round trip
+    # through HBM).  None = caches in the op's compute dtype.  The spec-tree
+    # buffers (sk/sv) stay in the compute dtype: they hold <= max_spec
+    # tokens per request and are rewritten every macro-step, so quantizing
+    # them saves ~nothing; accepted speculative KV is quantized when
+    # _commit() copies it into the committed cache.
+    kv_dtype: Optional[str] = None
+
     def __init__(
         self,
         embed_dim: int,
@@ -190,10 +202,23 @@ class IncMultiHeadSelfAttention(Op):
         """
         kv_shape = (max_requests + 1, self.num_kv_heads, max_seq_len, self.head_dim)
         sh = TensorSharding.from_axes(4, {1: head_axes} if head_axes else {})
+        kv_dt = self.kv_dtype or self.dtype
         out = {
-            "k": (kv_shape, self.dtype, sh),
-            "v": (kv_shape, self.dtype, sh),
+            "k": (kv_shape, kv_dt, sh),
+            "v": (kv_shape, kv_dt, sh),
         }
+        if kv_dt == "int8":
+            # per-(row, head, position) f32 dequant scales; sharded over the
+            # kv-head dim (dim 1) exactly like the caches they describe.
+            # Zero-init (allocate_kv_cache zeros everything): an untouched
+            # position dequantizes to 0 * 0 = 0, matching the fp cache's
+            # zeros, so the tiled/flat write-path equivalence is preserved.
+            sc_shape = kv_shape[:3]
+            sc_sh = TensorSharding.from_axes(
+                3, {1: head_axes} if head_axes else {}
+            )
+            out["k_scale"] = (sc_shape, "float32", sc_sh)
+            out["v_scale"] = (sc_shape, "float32", sc_sh)
         if max_spec_tokens:
             sp_shape = (
                 max_requests + 1,
@@ -326,6 +351,81 @@ class IncMultiHeadSelfAttention(Op):
             )
         return cache
 
+    # ---- int8 KV cache (kv_dtype="int8") -------------------------------
+    @staticmethod
+    def _kv_quant(x):
+        """Per-vector symmetric int8 quantization of fresh K/V entries.
+
+        ``x``: [T, KV, D] compute-dtype vectors.  Returns ``(q int8[T,KV,D],
+        scale f32[T,KV])`` with ``q * scale ~= x`` — one scale per (token,
+        head) vector, the per-head variant the KV literature defaults to
+        (per-channel would need static key statistics; per-vector absmax is
+        exact-by-construction and costs 4 bytes per 2*D-byte pair).
+        """
+        xf = x.astype(jnp.float32)
+        scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0        # [T, KV]
+        denom = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(xf / denom[..., None]), -127, 127)
+        return q.astype(jnp.int8), scale
+
+    @staticmethod
+    def _scatter_scale(cache, rows, pos, updates):
+        """``cache[rows[t], :, pos[t]] = updates[t]`` for scale buffers.
+
+        ``cache``: [R, KV, S] f32, ``updates``: [T, KV] — the 3-D sibling of
+        :meth:`_scatter_rows_pos` (same DUS-vs-scatter reasoning and clamped
+        out-of-range semantics).
+        """
+        t, h = updates.shape
+        upd = updates.astype(cache.dtype)
+        rows = jnp.clip(rows.astype(jnp.int32), 0, cache.shape[0] - 1)
+        pos = jnp.clip(pos.astype(jnp.int32), 0, cache.shape[2] - 1)
+        if t > DUS_MAX_TOKENS:
+            idx = jnp.stack([rows, pos], axis=-1)
+            dnums = jax.lax.ScatterDimensionNumbers(
+                update_window_dims=(1,),
+                inserted_window_dims=(0, 2),
+                scatter_dims_to_operand_dims=(0, 2),
+            )
+            return jax.lax.scatter(
+                cache, idx, upd, dnums,
+                mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS,
+            )
+        for i in range(t):
+            cache = jax.lax.dynamic_update_slice(
+                cache, upd[i].reshape(1, h, 1),
+                (rows[i], jnp.int32(0), pos[i]),
+            )
+        return cache
+
+    def _write_kv(self, state, rows, pos, k, v):
+        """Write this step's K/V vectors into the committed caches,
+        quantizing on write when the caches are int8.  Returns the updated
+        buffers as a dict of the state keys that changed."""
+        kc, vc = state["k"], state["v"]
+        if kc.dtype == jnp.int8:
+            kq, ks = self._kv_quant(k)
+            vq, vs = self._kv_quant(v)
+            return {
+                "k": self._scatter_rows_pos(kc, rows, pos, kq),
+                "v": self._scatter_rows_pos(vc, rows, pos, vq),
+                "k_scale": self._scatter_scale(state["k_scale"], rows, pos, ks),
+                "v_scale": self._scatter_scale(state["v_scale"], rows, pos, vs),
+            }
+        return {
+            "k": self._scatter_rows_pos(kc, rows, pos, k),
+            "v": self._scatter_rows_pos(vc, rows, pos, v),
+        }
+
+    @staticmethod
+    def _dequant_rows(cache_tok, scale_cache, rows, dtype):
+        """Gather-path dequant: ``cache_tok`` = cache[rows] ([T, KV, S, D]
+        int8), scales gathered the same way.  The materialization is
+        acceptable here — this is the fallback/oracle path; the Pallas
+        kernels fuse the same math in VMEM."""
+        sc = scale_cache[rows]  # [T, KV, S]
+        return (cache_tok.astype(jnp.float32) * sc[..., None]).astype(dtype)
+
     @staticmethod
     def _gather_rows_pos(cache, rows, pos):
         """``[T, H, D] = cache[rows[t], :, pos[t]]`` (same no-transpose
@@ -362,17 +462,12 @@ class IncMultiHeadSelfAttention(Op):
         nontrivial = {a for a in mesh.axis_names if mesh.shape[a] > 1}
         if not head_axes or not nontrivial.issubset(set(head_axes)):
             return None
-        try:
-            from jax import shard_map
-            kw = {"check_vma": False}  # jax >= 0.8 spelling
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
-            kw = {"check_rep": False}
+        from ..compat import shard_map
 
         def wrap(f):
             return shard_map(
                 f, mesh=mesh, in_specs=tuple(in_specs),
-                out_specs=out_specs, **kw,
+                out_specs=out_specs,
             )
 
         return wrap
@@ -381,12 +476,13 @@ class IncMultiHeadSelfAttention(Op):
         return tuple(ctx.config.get("head", ())) if ctx and ctx.config else ()
 
     def _inc_attend(self, q, k, v, state, bc: BatchConfig, ctx=None):
-        kc, vc = state["k"], state["v"]  # [R+1, KV, S, D]
+        kc = state["k"]  # [R+1, KV, S, D]
         nreq = kc.shape[0] - 1
         rows = self._rows(bc, nreq)
         pos = bc.token_position
-        kc = self._scatter_rows_pos(kc, rows, pos, k)
-        vc = self._scatter_rows_pos(vc, rows, pos, v)
+        writes = self._write_kv(state, rows, pos, k, v)
+        kc, vc = writes["k"], writes["v"]
+        kv_q = kc.dtype == jnp.int8
         if ctx is not None and ctx.extras.get("pallas_decode"):
             from jax.sharding import PartitionSpec as P
 
@@ -402,8 +498,9 @@ class IncMultiHeadSelfAttention(Op):
             slopes = alibi_slopes(self.num_q_heads).reshape(
                 self.num_kv_heads, self.q_per_kv
             )  # [KV, gq]: shardable over the kv-head dim
+            scales = (writes["k_scale"], writes["v_scale"]) if kv_q else ()
 
-            def attend(q_, kc_, vc_, rows_, pos_, slopes_):
+            def attend(q_, kc_, vc_, rows_, pos_, slopes_, *scales_):
                 kv_l, gq = q_.shape[1], q_.shape[2]
                 return decode_attention(
                     q_.reshape(t, kv_l * gq, self.head_dim),
@@ -411,23 +508,29 @@ class IncMultiHeadSelfAttention(Op):
                     scale=self.scaling_factor,
                     slopes=slopes_.reshape(-1) if self.use_alibi else None,
                     use_alibi=self.use_alibi, interpret=interp,
+                    k_scale=scales_[0] if scales_ else None,
+                    v_scale=scales_[1] if scales_ else None,
                 ).reshape(t, kv_l, gq, self.head_dim)
 
             h = self._config_head_axes(ctx)
             sm = self._head_shard_map(
                 ctx, h,
-                [P(None, h), P(None, h), P(None, h), P(), P(), P(h)],
+                [P(None, h), P(None, h), P(None, h), P(), P(), P(h)]
+                + [P(None, h)] * len(scales),
                 P(None, h),
             )
             if sm is not None:
-                out = sm(attend)(q, kc, vc, rows, pos, slopes)
+                out = sm(attend)(q, kc, vc, rows, pos, slopes, *scales)
                 out = out.reshape(t, self.num_q_heads, self.head_dim)
                 new_state = dict(state)
-                new_state["k"], new_state["v"] = kc, vc
+                new_state.update(writes)
                 return out, new_state
         # fallback: gather each token's cache row: [T, KV, S, D]
         k_tok = kc[rows]
         v_tok = vc[rows]
+        if kv_q:  # dequant (the Pallas path fuses this in-kernel instead)
+            k_tok = self._dequant_rows(k_tok, writes["k_scale"], rows, q.dtype)
+            v_tok = self._dequant_rows(v_tok, writes["v_scale"], rows, q.dtype)
         s = k_tok.shape[2]
         # causal over absolute positions (covers prefill + decode uniformly)
         mask = jnp.arange(s)[None, :] <= pos[:, None]  # [T, S]
@@ -450,7 +553,7 @@ class IncMultiHeadSelfAttention(Op):
         t = q.shape[0]
         out = out.reshape(t, self.num_q_heads, self.head_dim).astype(q.dtype)
         new_state = dict(state)
-        new_state["k"], new_state["v"] = kc, vc
+        new_state.update(writes)
         return out, new_state
 
     def _prefill_attend(self, q, k, v, state, bc: PrefillBatchConfig, ctx):
@@ -484,6 +587,16 @@ class IncMultiHeadSelfAttention(Op):
         bq = bc.tile_size
         g = t // bq
         interp = bool(ctx.extras.get("pallas_interpret"))
+        kv_q = kc.dtype == jnp.int8
+        h = self._config_head_axes(ctx)
+        sm = self._head_shard_map(
+            ctx, h,
+            [P(None, h), P(None, h), P(None, h), P(), P()]
+            + [P(None, h)] * (2 if kv_q else 0),
+            P(None, h),
+        )
+        if sm is None:  # unsupported sharding: flat gather fallback
+            return self._inc_attend(q, k, v, state, base, ctx)
         # tile row: real slots sit at the tile head, pads map to the scratch
         # row nreq (the largest index), so min() recovers the tile's request
         tile_rows = jnp.min(rows.reshape(g, bq), axis=1)
@@ -505,6 +618,23 @@ class IncMultiHeadSelfAttention(Op):
         # WRITES position p before any token's causal frontier reaches p
         # (the scratch-row behavior of fully-pad tiles is unchanged: min()
         # maps them to row nreq).
+        if kv_q:
+            # quantize-on-write: the int8 VALUES ride the same per-tile
+            # block DUS as the fp path; the per-(token, head) scales ride a
+            # matching [1, KV, bq] block DUS into the scale caches.  Tile
+            # pads write value 0 AND scale 0, so they dequantize to the
+            # zeros the fp path writes (the tiled/flat bit-identity note
+            # above carries over to the quantized representation).
+            k, ks = self._kv_quant(k)   # int8 [T, KV, D], f32 [T, KV]
+            v, vs = self._kv_quant(v)
+            ksc, vsc = state["k_scale"], state["v_scale"]  # [R+1, KV, S]
+            valid_s = (base.request_index >= 0).reshape(g, 1, bq)
+            ksb = jnp.where(
+                valid_s, ks.reshape(g, bq, self.num_kv_heads)
+                .transpose(0, 2, 1), 0.0)
+            vsb = jnp.where(
+                valid_s, vs.reshape(g, bq, self.num_kv_heads)
+                .transpose(0, 2, 1), 0.0)
         valid = (base.request_index >= 0).reshape(g, 1, bq, 1)
         kb = k.reshape(g, bq, self.num_kv_heads, self.head_dim) \
              .transpose(0, 2, 1, 3).astype(kc.dtype)
@@ -517,8 +647,14 @@ class IncMultiHeadSelfAttention(Op):
             at = (tile_rows[i], zero, pstart[i], zero)
             kc = jax.lax.dynamic_update_slice(kc, kb[i][None], at)
             vc = jax.lax.dynamic_update_slice(vc, vb[i][None], at)
+            if kv_q:
+                ksc = jax.lax.dynamic_update_slice(
+                    ksc, ksb[i][None], at[:3])
+                vsc = jax.lax.dynamic_update_slice(
+                    vsc, vsb[i][None], at[:3])
+        scales = (ksc, vsc) if kv_q else ()
 
-        def attend(q_, kc_, vc_, rows_, pstart_):
+        def attend(q_, kc_, vc_, rows_, pstart_, *scales_):
             kv_l, gq = q_.shape[1], q_.shape[2]
             return prefill_attention(
                 q_.reshape(t, kv_l * gq, self.head_dim).reshape(
@@ -526,20 +662,16 @@ class IncMultiHeadSelfAttention(Op):
                 ),
                 kc_, vc_, rows_, pstart_,
                 scale=self.scaling_factor, interpret=interp,
+                k_scale=scales_[0] if scales_ else None,
+                v_scale=scales_[1] if scales_ else None,
             ).reshape(t, kv_l, gq, self.head_dim)
 
-        h = self._config_head_axes(ctx)
-        sm = self._head_shard_map(
-            ctx, h,
-            [P(None, h), P(None, h), P(None, h), P(), P()],
-            P(None, h),
-        )
-        if sm is None:  # unsupported sharding: flat gather fallback
-            return self._inc_attend(q, k, v, state, base, ctx)
-        out = sm(attend)(q, kc, vc, tile_rows, pstart)
+        out = sm(attend)(q, kc, vc, tile_rows, pstart, *scales)
         out = out.reshape(t, self.num_q_heads, self.head_dim)
         new_state = dict(state)
         new_state["k"], new_state["v"] = kc, vc
+        if kv_q:
+            new_state["k_scale"], new_state["v_scale"] = ksc, vsc
         return out, new_state
 
     def _commit(self, state, bc: TreeVerifyBatchConfig):
@@ -550,20 +682,22 @@ class IncMultiHeadSelfAttention(Op):
         previous macro-step become part of the causal past before the new
         tree is scored.
         """
-        kc, vc, sk, sv = state["k"], state["v"], state["sk"], state["sv"]
+        kc, sk, sv = state["k"], state["sk"], state["sv"]
         nreq = kc.shape[0] - 1
         rows = jnp.where(bc.commit_request_index >= 0, bc.commit_request_index, nreq)
-        # _scatter/_gather_rows_pos clip rows/pos internally
+        # _scatter/_gather_rows_pos clip rows/pos internally.  The spec
+        # buffers hold compute-dtype KV; with an int8 committed cache,
+        # _write_kv quantizes the accepted vectors here — the same
+        # quantizer the incremental path applies, so a token's cache entry
+        # is bit-identical whichever path wrote it.
         src = bc.commit_src_spec_index
         dst = bc.commit_dst_position
-        kc = self._scatter_rows_pos(
-            kc, rows, dst, self._gather_rows_pos(sk, rows, src)
-        )
-        vc = self._scatter_rows_pos(
-            vc, rows, dst, self._gather_rows_pos(sv, rows, src)
-        )
         new_state = dict(state)
-        new_state["k"], new_state["v"] = kc, vc
+        new_state.update(self._write_kv(
+            state, rows, dst,
+            self._gather_rows_pos(sk, rows, src),
+            self._gather_rows_pos(sv, rows, src),
+        ))
         return new_state
 
     def _tree_attend(self, q, k, v, state, bc, ctx=None):
@@ -605,10 +739,15 @@ class IncMultiHeadSelfAttention(Op):
             # tree tokens of a request share one kernel grid row, so the
             # committed cache streams once per REQUEST, not once per token
             layout = ctx.extras.get("tree_layout")
+            kv_q = kc.dtype == jnp.int8
+            scales = (state["k_scale"], state["v_scale"]) if kv_q else ()
 
-            def attend(q_, kc_, vc_, sk_, sv_, rows_, clens_, amask_):
+            def attend(q_, kc_, vc_, sk_, sv_, rows_, clens_, amask_,
+                       *scales_):
                 kv_l, gq = q_.shape[1], q_.shape[2]
                 d = self.head_dim
+                ks_ = scales_[0] if scales_ else None
+                vs_ = scales_[1] if scales_ else None
                 if layout:
                     r_t, p_t = layout
                     used = r_t * p_t
@@ -619,6 +758,7 @@ class IncMultiHeadSelfAttention(Op):
                         rows_[:used:p_t], clens_[:used:p_t],
                         amask_[:used].reshape(r_t, p_t, -1),
                         scale=self.scaling_factor, interpret=interp,
+                        k_scale=ks_, v_scale=vs_,
                     ).reshape(used, kv_l * gq, d)
                     if used < t:  # capacity-pad tokens: outputs are ignored
                         ob = jnp.zeros((t, kv_l * gq, d), ob.dtype) \
@@ -628,16 +768,19 @@ class IncMultiHeadSelfAttention(Op):
                     q_.reshape(t, kv_l * gq, d),
                     kc_, vc_, sk_, sv_, rows_, clens_, amask_,
                     scale=self.scaling_factor, interpret=interp,
+                    k_scale=ks_, v_scale=vs_,
                 ).reshape(t, kv_l, gq, d)
 
             h = self._config_head_axes(ctx)
             sm = self._head_shard_map(
                 ctx, h,
-                [P(None, h)] * 5 + [P(), P(), P()],
+                [P(None, h)] * 5 + [P(), P(), P()]
+                + [P(None, h)] * len(scales),
                 P(None, h),
             )
             if sm is not None:
-                out = sm(attend)(q, kc, vc, sk, sv, rows, clens, amask)
+                out = sm(attend)(q, kc, vc, sk, sv, rows, clens, amask,
+                                 *scales)
                 out = out.reshape(t, self.num_q_heads, self.head_dim)
                 new_state = dict(state)
                 new_state["sk"], new_state["sv"] = sk, sv
@@ -645,6 +788,11 @@ class IncMultiHeadSelfAttention(Op):
 
         k_cache_tok = kc[rows]   # [T, KV, S, D]
         v_cache_tok = vc[rows]
+        if kc.dtype == jnp.int8:  # dequant (Pallas path fuses this instead)
+            k_cache_tok = self._dequant_rows(
+                k_cache_tok, state["k_scale"], rows, q.dtype)
+            v_cache_tok = self._dequant_rows(
+                v_cache_tok, state["v_scale"], rows, q.dtype)
         k_spec_tok = sk[rows]    # [T, KV, P, D]
         v_spec_tok = sv[rows]
         s = k_cache_tok.shape[2]

@@ -67,12 +67,14 @@ def quantize_int8(im, include: Optional[Sequence[str]] = None,
                   attention: bool = True) -> int:
     """Quantize the serve model's weight matrices to int8 in place.
 
-    ``include``: optional name substrings restricting which Linear nodes
-    quantize (default: every Linear with a 2-D kernel).  ``attention``:
-    also quantize the attention op's fused ``qkv`` and ``o_proj``.
-    Returns the number of quantized weight arrays.  Call after
-    ``init_operators_inference`` (and any HF weight load); re-quantizing is
-    a no-op (int8 arrays are skipped).
+    ``include``: optional name substrings restricting which nodes quantize
+    (default: every Linear with a 2-D kernel + every attention op's fused
+    projections).  The filter applies to BOTH branches — ``attention=True``
+    only opts the attention ops in, it does not override ``include``
+    (ADVICE r5 low).  ``attention``: also quantize the attention op's fused
+    ``qkv`` and ``o_proj``.  Returns the number of quantized weight arrays.
+    Call after ``init_operators_inference`` (and any HF weight load);
+    re-quantizing is a no-op (int8 arrays are skipped).
     """
     assert im.params is not None, "call init_operators_inference() first"
     mesh = im.model.mesh
@@ -82,9 +84,9 @@ def quantize_int8(im, include: Optional[Sequence[str]] = None,
         g = im.params.get(node.name)
         if g is None:
             continue
+        if include and not any(s in node.name for s in include):
+            continue
         if isinstance(op, Linear):
-            if include and not any(s in node.name for s in include):
-                continue
             k = g.get("kernel")
             if k is None or k.dtype == jnp.int8:
                 continue
@@ -106,7 +108,40 @@ def quantize_int8(im, include: Optional[Sequence[str]] = None,
                 g[f"{pname}_scale"] = (
                     jax.device_put(jnp.asarray(scale), ssh)
                     if ssh is not None else jnp.asarray(scale))
+                op.quantization = "int8"  # capacity planning (see below)
                 n += 1
+    return n
+
+
+def annotate_int8(graph, include: Optional[Sequence[str]] = None,
+                  attention: bool = True) -> int:
+    """Mark a serve graph's weight matrices as int8 FOR CAPACITY PLANNING,
+    without touching any arrays.
+
+    ``plan_memory_bytes`` (search/simulator.py) counts params marked
+    ``op.quantization == "int8"`` at 1 byte/element + per-out-channel f32
+    scales — the planning-time counterpart of :func:`quantize_int8`, usable
+    on a purely symbolic graph (no ``init_operators_inference`` needed).
+    This is how the full-depth 32-layer 7B-shape config is budgeted BEFORE
+    allocating anything: build the graph, ``annotate_int8`` it, register
+    the serve capacities (+ ``kv_dtype="int8"``), and check
+    ``plan_memory_bytes(plan, training=False)`` against the chip's HBM.
+    Same ``include``/``attention`` selection rules as :func:`quantize_int8`.
+    Returns the number of ops marked.
+    """
+    n = 0
+    for node in graph.nodes:
+        op = node.op
+        if include and not any(s in node.name for s in include):
+            continue
+        if isinstance(op, Linear):
+            if any(p.name == "kernel" and len(p.spec.shape) == 2
+                   for p in op.params()):
+                op.quantization = "int8"
+                n += 1
+        elif attention and hasattr(op, "num_kv_heads"):
+            op.quantization = "int8"
+            n += 1
     return n
 
 

@@ -38,6 +38,9 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     prefill_offset: int = 0     # prompt tokens already fed to the model
     slot: int = -1
+    # consecutive mixed-batch steps in which the tiled budget rounded this
+    # request's prefill take to zero (starvation fallback, ADVICE r5 low)
+    starved_steps: int = 0
 
     @property
     def seq_len(self) -> int:
@@ -187,6 +190,7 @@ class RequestManager:
                     (req.slot, req.prompt[start: start + take], start)
                 )
                 req.prefill_offset += take
+                req.starved_steps = 0
                 budget -= -(-take // tile) * tile  # padded tiles consumed
                 if req.prefill_offset == len(req.prompt):
                     sample_points.append((req.slot, req.rid))
@@ -214,20 +218,48 @@ class RequestManager:
             remaining = len(req.prompt) - req.prefill_offset
             if remaining <= budget:
                 take = remaining
-            elif tile > 1 and self.im.use_pallas:
+            elif (tile > 1 and self.im.use_pallas
+                    and req.prefill_offset % tile == 0):
                 # only the Pallas tiled path consumes the alignment; the
-                # gather path must not stall prefill for it
+                # gather path must not stall prefill for it — and a request
+                # already off-tile (starvation fallback below) has nothing
+                # left to protect, so it skips the rounding entirely
                 take = (budget // tile) * tile
                 if take == 0:
-                    continue  # budget < one tile: keep alignment, wait
+                    # budget < one tile: normally wait to keep alignment —
+                    # but when decode tokens leave less than a tile of
+                    # budget EVERY step, waiting starves the prompt until
+                    # the decoders finish (unbounded TTFT, ADVICE r5 low).
+                    # After ``starvation_limit`` consecutive dry steps, take
+                    # an UNALIGNED flat chunk: the offset goes off-tile, so
+                    # the tiled-branch alignment gate above routes this
+                    # request's later chunks through the flat gather path —
+                    # slower per token, but it makes progress every step.
+                    req.starved_steps += 1
+                    if req.starved_steps < self.starvation_limit:
+                        continue
+                    take = budget
             else:
                 take = budget
+                if tile > 1 and self.im.use_pallas and budget >= tile:
+                    # an off-tile offset (starvation fallback above) blocks
+                    # the tiled pure-prefill path for EVERY concurrently
+                    # prefilling request (the alignment gate is all-or-
+                    # nothing).  In budget-rich steps round the take so the
+                    # offset lands back on a tile boundary: one slightly
+                    # smaller take buys the Q-tiled kernel back for the
+                    # whole batch.  Starved steps (budget < tile) keep the
+                    # full take — progress beats re-alignment there.
+                    over = (req.prefill_offset + take) % tile
+                    if 0 < over < take:
+                        take -= over
             start = req.prefill_offset
             for j in range(take):
                 tokens.append(req.prompt[start + j])
                 req_idx.append(req.slot)
                 positions.append(start + j)
             req.prefill_offset += take
+            req.starved_steps = 0
             budget -= take
             if req.prefill_offset == len(req.prompt):
                 # output at the last prompt token = first generated token
@@ -297,6 +329,10 @@ class RequestManager:
         return n
 
     scan_chunk = 32  # sync-amortization window for the decode scan
+    # mixed decode+prefill steps whose tiled budget rounds to 0 before the
+    # starved request falls back to an unaligned flat-path take (bounds the
+    # TTFT inflation at ~limit decode steps; see prepare_next_batch)
+    starvation_limit = 4
 
     # ------------------------------------------------------------------
     def _prefill_stretch_possible(self) -> bool:

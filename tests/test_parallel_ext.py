@@ -11,6 +11,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.compat import shard_map
 from flexflow_tpu.parallel.mesh import make_mesh
 from flexflow_tpu.parallel.pipeline import pipeline_apply, pipeline_train_step
 from flexflow_tpu.parallel.ring_attention import ring_attention
@@ -37,12 +38,11 @@ def test_ring_attention_matches_full(causal):
     mesh = make_mesh({"sp": n}, jax.devices()[:n])
 
     ringed = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, "sp", n, causal, scale),
             mesh=mesh,
             in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
             out_specs=P(None, "sp"),
-            check_vma=False,
         )
     )(q, k, v)
     want = full_attention(q, k, v, causal, scale)
@@ -98,12 +98,11 @@ def test_pipeline_apply_matches_sequential():
 
     mesh = make_mesh({"pp": n_stages}, jax.devices()[:n_stages])
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda p, x: pipeline_apply(stage_mlp, p, x, "pp", n_stages),
             mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P("pp"), params), P()),
             out_specs=P(),
-            check_vma=False,
         )
     )(params, x)
 

@@ -11,7 +11,11 @@ import numpy as np
 import pytest
 
 from flexflow_tpu.ops.pallas.attention import prefill_attention
-from flexflow_tpu.serve import GenerationConfig, RequestManager
+from flexflow_tpu.serve import (
+    GenerationConfig,
+    RequestManager,
+    RequestStatus,
+)
 from flexflow_tpu.serve.batch_config import BatchConfig, PrefillBatchConfig
 
 from test_pallas_attention import ref_attention
@@ -138,6 +142,83 @@ def test_request_manager_emits_prefill_batch_config():
     rm.process_result(res, points)
     bc2, _ = rm.prepare_next_batch()
     assert isinstance(bc2, BatchConfig)
+
+
+def test_prefill_tile_divides_max_seq_len():
+    """ADVICE r5 medium: the tile must divide max_seq_len so the tiled
+    block-DUS contract is independent of the cache's 128-padding detail.
+    36 % 16 != 0 and 36 % 8 != 0, so the tile shrinks to 4."""
+    im = make_im(max_tokens=16, max_requests=2, max_seq=36, use_pallas=True)
+    assert im.prefill_tile == 4
+    assert 36 % im.prefill_tile == 0
+    # power-of-two max_seq keeps the full tile
+    im2 = make_im(max_tokens=16, max_requests=2, max_seq=64, use_pallas=True)
+    assert im2.prefill_tile == 16
+    # generation through the shrunken tile stays correct
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=3))
+    prompt = [5, 9, 2, 11, 3, 7, 1]
+    got = rm.generate([prompt])[0]
+    assert got == ref_greedy_decode(im.params, TINY, prompt, 3)
+
+
+def test_tiled_budget_starvation_falls_back_to_flat():
+    """Regression (ADVICE r5 low): with max_tokens == tile and an active
+    decoder, every mixed step leaves budget < one tile, which used to
+    postpone prefill until the decoder finished (unbounded TTFT).  After
+    ``starvation_limit`` dry steps the manager must take an unaligned flat
+    chunk so the queued prompt makes progress — and its output must still
+    match the golden."""
+    im = make_im(max_tokens=4, max_requests=2, max_seq=64, use_pallas=True)
+    assert im.prefill_tile == 4
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=24))
+    prompt_a = [3, 11, 25, 40, 7][: im.prefill_tile]  # one-tile prompt
+    rm.register_new_request(prompt_a)  # A: prefills in one step, then decodes
+    bc, pts = rm.prepare_next_batch()
+    rm.process_result(im.step(bc), pts)
+    req_a = rm._active()[0]
+    assert req_a.status is RequestStatus.DECODING
+    # B arrives: every step now carries A's decode token, budget = 3 < tile
+    prompt_b = [2, 4, 6, 8, 10, 12]
+    rid_b = rm.register_new_request(prompt_b, max_new_tokens=2)
+    steps_until_b = None
+    for step in range(1, 16):
+        bc, pts = rm.prepare_next_batch()
+        rm.process_result(im.step(bc), pts)
+        if rm.requests[rid_b].generated:
+            steps_until_b = step
+            break
+    # without the fallback B would wait all ~23 remaining decode steps of A
+    assert steps_until_b is not None and steps_until_b <= 4 + len(prompt_b), (
+        f"B starved: no first token after {steps_until_b} steps")
+    # drain and check correctness of both requests
+    while rm.has_work():
+        bc, pts = rm.prepare_next_batch()
+        rm.process_result(im.step(bc), pts)
+    assert rm.requests[rid_b].generated == ref_greedy_decode(
+        im.params, TINY, prompt_b, 2)
+
+
+def test_off_tile_prefill_realigns_in_budget_rich_step():
+    """Follow-up to the starvation fallback: an off-tile offset blocks the
+    tiled pure-prefill path for EVERY concurrently prefilling request (the
+    alignment gate is all-or-nothing), so the first budget-rich step must
+    round its take to land the offset back on a tile boundary — after
+    which the manager emits PrefillBatchConfig again."""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=64, use_pallas=True)
+    assert im.prefill_tile == 8
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=2))
+    prompt = [(i % 50) + 1 for i in range(19)]
+    rid = rm.register_new_request(prompt)
+    req = rm.requests[rid]
+    req.prefill_offset = 3  # as if a starvation fallback took 3 unaligned
+    bc, _ = rm.prepare_next_batch()
+    # off-tile: flat layout, take rounded 8 -> 5 so the offset re-aligns
+    assert not isinstance(bc, PrefillBatchConfig)
+    assert req.prefill_offset == 8
+    bc2, _ = rm.prepare_next_batch()
+    # re-aligned: the tiled Pallas path is available again
+    assert isinstance(bc2, PrefillBatchConfig)
+    assert req.prefill_offset == 16
 
 
 def test_mixed_decode_prefill_keeps_tile_alignment():

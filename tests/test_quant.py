@@ -70,3 +70,41 @@ def test_int8_generation_still_decodes():
     out = rm.generate([[5, 9, 2, 11, 3]])
     assert len(out[0]) == 4
     assert all(isinstance(t, int) for t in out[0])
+
+
+def test_include_filter_applies_to_attention():
+    """ADVICE r5 low: ``include`` must gate the attention branch too —
+    quantizing only the MLP must leave every qkv/o_proj untouched."""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=32, use_pallas=False)
+    n = quantize_int8(im, include=["mlp"])
+    assert n == TINY.num_hidden_layers * 3  # gate/up/down per layer
+    for name, g in im.params.items():
+        for pname, x in g.items():
+            if "mlp" in name and pname == "kernel":
+                assert x.dtype == jnp.int8, name
+            elif pname in ("qkv", "o_proj", "kernel"):
+                assert x.dtype != jnp.int8, f"{name}.{pname} quantized"
+
+
+def test_int8_serve_step_matches_fp_tp2():
+    """tp=2 variant (ADVICE r5 low): covers the sharded ``_scale_sharding``
+    path — per-out-channel scales must shard like their kernels, and the
+    quantized TP step must track the fp TP step."""
+    im_fp = make_im({"tp": 2}, max_tokens=8, max_requests=2, max_seq=32,
+                    use_pallas=False)
+    im_q = make_im({"tp": 2}, max_tokens=8, max_requests=2, max_seq=32,
+                   use_pallas=False, seed=11)
+    im_q.params = jax.tree.map(lambda x: x, im_fp.params)  # same weights
+    n = quantize_int8(im_q)
+    assert n >= TINY.num_hidden_layers * 2 + 1
+
+    from flexflow_tpu.serve.batch_config import BatchConfig
+
+    prompt = [5, 9, 2, 11, 3]
+    bc = BatchConfig.build(prompt, [0] * 5, list(range(5)), [5],
+                           max_tokens=8, max_requests=2)
+    r_fp = im_fp.step(bc)
+    r_q = im_q.step(bc)
+    a = np.asarray(r_fp.logits_max)[:5]
+    b = np.asarray(r_q.logits_max)[:5]
+    np.testing.assert_allclose(b, a, rtol=0.2, atol=0.5)

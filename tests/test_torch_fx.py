@@ -175,6 +175,9 @@ def test_cnn_imported_model_trains():
     rng = np.random.RandomState(0)
     X = rng.randn(8, 3, 16, 16).astype(np.float32)
     y = rng.randint(0, 5, size=8).astype(np.int32)
-    hist = model.fit(X, y, epochs=2, batch_size=4, verbose=False)
+    # 4 epochs, not 2: SGD on this tiny batch can tick up on the second
+    # epoch (observed 1.5247 -> 1.5271 under this torch init) before the
+    # downward trend dominates; the assertion gates the trend, not one step
+    hist = model.fit(X, y, epochs=4, batch_size=4, verbose=False)
     assert np.isfinite(hist[-1]["loss"])
     assert hist[-1]["loss"] <= hist[0]["loss"] + 1e-3
