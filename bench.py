@@ -182,8 +182,17 @@ def step_bytes(im, ctx, block_s=None):
     correct denominator for the measured kernel (part of the bf16
     ``hbm_frac`` 0.861-vs-int8-1.015 gap is this undercount, see
     ``hbm_frac_note``)."""
-    import jax
+    return sum(step_byte_parts(im, ctx, block_s).values())
 
+
+def step_byte_parts(im, ctx, block_s=None):
+    """:func:`step_bytes` decomposed: ``{weights, kv_read, kv_write}``
+    bytes per decode step.  The per-component split is what lets a device
+    run ATTRIBUTE a roofline shortfall (VERDICT r5 weak #3): weights scale
+    with the quantization recipe, kv_read with context and block
+    granularity, kv_write is constant — so comparing the bf16 and int8
+    sections' parts on the same median-TPOT basis says which component's
+    sustained bandwidth (not the accounting) is short."""
     p_bytes = 0
     for name, group in im.params.items():
         for pname, x in group.items():
@@ -194,7 +203,7 @@ def step_bytes(im, ctx, block_s=None):
     live = ctx + 1
     if block_s:
         live = -(-live // block_s) * block_s
-    kv_bytes = 0
+    kv_read = kv_write = 0
     for bufs in im.state.values():
         k = bufs["k"]  # [R+1, KV, S, D]
         _, num_kv, _, d = k.shape
@@ -202,9 +211,9 @@ def step_bytes(im, ctx, block_s=None):
         vec = num_kv * d * k.dtype.itemsize
         if "k_scale" in bufs:  # int8 KV: f32 scales stream with the blocks
             vec += num_kv * bufs["k_scale"].dtype.itemsize
-        kv_bytes += 2 * t * live * vec  # read (K + V)
-        kv_bytes += 2 * t * vec         # write
-    return p_bytes + kv_bytes
+        kv_read += 2 * t * live * vec   # read (K + V)
+        kv_write += 2 * t * vec         # write
+    return {"weights": p_bytes, "kv_read": kv_read, "kv_write": kv_write}
 
 
 def decode_block_s(im):
@@ -249,7 +258,7 @@ def prefill_im(im, prompts):
             for r in range(len(prompts))]
 
 
-def bench_ttft(ctx=1800, n_outer=3, cap=256,
+def bench_ttft(ctx=1800, n_outer=3, cap=512, sweep=(256, 1024),
                shape=dict(layers=8, hidden=4096, heads=32, kv=32,
                           inter=11008, vocab=32000, max_requests=8,
                           max_seq=2048)):
@@ -261,15 +270,24 @@ def bench_ttft(ctx=1800, n_outer=3, cap=256,
     ``prefill_vs_flat`` compares against the same chunks routed through the
     per-token decode-kernel grid — the r3 status quo VERDICT flagged as
     unsuited (each token re-streams the committed prefix).
+
+    The headline runs with BOTH r6 levers on (LM-head gating + cross-chunk
+    overlap); ``prefill_ablation`` re-measures with each lever off alone so
+    the artifact attributes the MFU to the lever that earned it — an
+    overlap delta of ~0 is the measured "XLA's scheduler refused the
+    cross-iteration overlap" record.  ``prefill_cap_sweep`` re-runs the
+    headline config at the other chunk caps (fresh InferenceManager each:
+    the cap is a compile-time capacity).
     """
+    import jax
+
     from flexflow_tpu.serve import GenerationConfig, RequestManager
 
-    im = build_im(use_pallas=True, max_tokens=cap, **shape)
     rng = np.random.RandomState(1)
     bs = shape["max_requests"]
     prompts = rng.randint(1, shape["vocab"] - 1, size=(bs, ctx)).tolist()
 
-    def run_once():
+    def run_once(im):
         im.reset()
         rm = RequestManager(im, GenerationConfig(max_new_tokens=1))
         for p in prompts:
@@ -278,14 +296,19 @@ def bench_ttft(ctx=1800, n_outer=3, cap=256,
         rm.serve_incr_decoding()
         return time.perf_counter() - t0
 
+    def best_of(im, k=n_outer):
+        run_once(im)  # compile + warm
+        return min(run_once(im) for _ in range(k))
+
+    im = build_im(use_pallas=True, max_tokens=cap, **shape)
     tile = im.prefill_tile
-    run_once()  # compile + warm
-    tiled = min(run_once() for _ in range(n_outer))
+    tiled = best_of(im)
     # MFU basis (VERDICT r4 #2): GEMM flops 2*P per token (P = matmul
     # params, embedding gather excluded) + causal attention score/value
-    # flops 4*avg_pos*QH*D per layer at average position ctx/2
-    import jax
-
+    # flops 4*avg_pos*QH*D per layer at average position ctx/2.  The basis
+    # is the UNGATED program's flops — gating removes work, so its win
+    # shows up as higher tokens/s against the same per-token flops, and
+    # the MFU stays comparable across the ablation rows.
     p_matmul = matmul_param_count(im)
     layers, qh = shape["layers"], shape["heads"]
     d = shape["hidden"] // qh
@@ -293,23 +316,66 @@ def bench_ttft(ctx=1800, n_outer=3, cap=256,
     flops_per_token = 2 * p_matmul + att_flops
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS_BF16.get(kind)
+
+    def mfu(tps):
+        return round(tps * flops_per_token / peak, 4) if peak else None
+
     tps = bs * ctx / tiled
-    im.prefill_tile = 1  # force the flat path (per-token decode-kernel grid)
-    run_once()
-    flat = min(run_once() for _ in range(n_outer))
+
+    # ---- per-lever ablations (each off alone, the other on) ----------
+    gate_on = bool(im.gate_lm_head)  # False if the graph couldn't be marked
+    im.gate_lm_head = False  # host-side: chunks stop carrying logit_slots
+    t_no_gate = best_of(im)
+    im.gate_lm_head = gate_on
+    overlap_on = bool(im.prefill_overlap)
+    t_no_overlap = None
+    if overlap_on:
+        im.prefill_overlap = False  # static jit arg: next call recompiles
+        t_no_overlap = best_of(im)
+        im.prefill_overlap = True
+    ablation = {
+        "gating_off_tokens_per_sec": round(bs * ctx / t_no_gate, 1),
+        "gating_off_mfu": mfu(bs * ctx / t_no_gate),
+        "overlap_off_tokens_per_sec": round(bs * ctx / t_no_overlap, 1)
+        if t_no_overlap else None,
+        "overlap_off_mfu": mfu(bs * ctx / t_no_overlap)
+        if t_no_overlap else None,
+        "note": "each lever disabled alone (other on); headline has both "
+                "on.  overlap_off ~= headline means XLA already refuses / "
+                "doesn't need the cross-iteration overlap — record it as "
+                "scheduler-bound, per the r6 plan",
+    }
+
+    # ---- flat-path comparison (the r3 status quo) --------------------
+    im.prefill_tile = 1  # force the per-token decode-kernel grid
+    flat = best_of(im)
     release_im(im)
+
+    # ---- chunk-cap sweep (fresh IM per cap; the r5 sweep, kept live) --
+    cap_sweep = {str(cap): round(tps, 1)}
+    for c in sweep:
+        im_c = build_im(use_pallas=True, max_tokens=c, **shape)
+        t_c = best_of(im_c, k=max(n_outer - 1, 1))
+        release_im(im_c)
+        cap_sweep[str(c)] = round(bs * ctx / t_c, 1)
+
     return {
         "ttft_ms": round(tiled * 1e3, 1),
         "prefill_tokens_per_sec": round(tps, 1),
-        "prefill_mfu": round(tps * flops_per_token / peak, 4)
-        if peak else None,
+        "prefill_mfu": mfu(tps),
         "prefill_flops_per_token": round(flops_per_token / 1e9, 3),
         "prefill_mfu_note": "flops basis: 2*matmul_params(+attention at "
                             "avg pos ctx/2) per token; denominator is the "
                             "chip's bf16 peak",
+        "prefill_gating": gate_on,
+        "prefill_overlap": overlap_on,
+        "prefill_tile": tile,
+        "prefill_ablation": ablation,
+        "prefill_cap_sweep": cap_sweep,
         "prefill_vs_flat": round(flat / tiled, 3),
         "ttft_config": f"bs={bs} ctx={ctx} cap={cap} tile={tile}, chunked "
-                       "prefill via RequestManager; flat = same chunks "
+                       "prefill via RequestManager (LM-head gating + "
+                       "cross-chunk overlap on); flat = same chunks "
                        "through the per-token decode-kernel grid (the r3 "
                        "path)",
     }
@@ -877,6 +943,20 @@ def bench_cost_model():
     }
 
 
+def ttft_fields(doc, fields):
+    """Merge the prefill/TTFT section into the bench doc.
+
+    Deliberately WHITELIST-FREE: the ``perturbation_regret`` drop (VERDICT
+    r5 weak #1) came from a cherry-picking merge in
+    :func:`searched_vs_dp_fields`; every field :func:`bench_ttft` computes
+    — including the r6 ``prefill_ablation`` / ``prefill_cap_sweep`` keys —
+    lands in the artifact verbatim, and the hermetic merge test
+    (tests/test_prefill_gating.py) pins that it stays that way.
+    """
+    doc.update(fields)
+    return doc
+
+
 def searched_vs_dp_fields():
     """Run bench_search.py (north-star #1: Unity search vs hand-DP) in a
     subprocess — it needs the 8-device virtual CPU mesh, and this process
@@ -958,7 +1038,8 @@ def main():
     mark("decode/pallas")
     im = build_im(use_pallas=True, **shape)
     pallas_tpot, pallas_tpot_med = bench_decode_scan(im, ctx, spread=True)
-    bytes_per_step = step_bytes(im, ctx)
+    byte_parts = step_byte_parts(im, ctx)
+    bytes_per_step = sum(byte_parts.values())
     step_bytes_block = step_bytes(im, ctx, block_s=decode_block_s(im))
     release_im(im)
     doc.update({
@@ -996,7 +1077,19 @@ def main():
                          "(256-position blocks at this shape: ctx=1800 "
                          "reads 2048 positions/req). "
                          "hbm_frac_block + the *_median int8 fields put "
-                         "both paths on one basis",
+                         "both paths on one basis; hbm_parts_gb splits "
+                         "the numerator so a residual shortfall is "
+                         "attributable per component (weights stream vs "
+                         "KV read) rather than to 'the step'",
+        # numerator decomposition (must-move basis): at this shape the
+        # block-granular KV undercount is only ~1% of TOTAL step bytes
+        # (KV is ~6% of traffic at ctx=1800), so basis choices explain
+        # ~6 of the 14 points — the parts + one-basis fields above are
+        # what lets the next device run attribute the rest (VERDICT r5
+        # weak #3 follow-through)
+        "hbm_parts_gb": {
+            k: round(v / 1e9, 3) for k, v in byte_parts.items()
+        },
         "config": "llama2-7b-shape 8-layer slice, bf16, bs=8, ctx=1800",
         "device": kind,
     })
@@ -1004,8 +1097,10 @@ def main():
     def do_ttft():
         # cap=512: chunk-cap sweep (r5) measured 256/512/1024 at 21.0k /
         # 25.7k / 25.8k prefill tok/s (39%/47%/47% MFU) — bigger chunks
-        # amortize per-chunk weight streaming; 512 takes nearly all of it
-        doc.update(bench_ttft(ctx=ctx, cap=512))
+        # amortize per-chunk weight streaming; 512 takes nearly all of it.
+        # r6 re-sweeps live (prefill_cap_sweep) since the gating/overlap/
+        # wide-tile levers shift where the knee sits.
+        ttft_fields(doc, bench_ttft(ctx=ctx, cap=512))
 
     def do_spec():
         spec = bench_spec_decode(ctx=ctx)
@@ -1037,9 +1132,12 @@ def main():
         im = build_im(use_pallas=True, **shape)
         n_q = quantize_int8(im)
         int8_tpot, int8_med = bench_decode_scan(im, ctx, spread=True)
-        int8_bytes = step_bytes(im, ctx)
+        int8_parts = step_byte_parts(im, ctx)
+        int8_bytes = sum(int8_parts.values())
         int8_bytes_block = step_bytes(im, ctx, block_s=decode_block_s(im))
         release_im(im)
+        doc["int8_hbm_parts_gb"] = {
+            k: round(v / 1e9, 3) for k, v in int8_parts.items()}
         doc["int8_tpot_ms"] = round(int8_tpot * 1e3, 3)
         doc["int8_tpot_ms_median"] = round(int8_med * 1e3, 3)
         doc["int8_vs_bf16"] = round(pallas_tpot / int8_tpot, 3)

@@ -34,6 +34,19 @@ from .elementwise import UNARY_FNS, propagate
 class Linear(Op):
     type_name = "linear"
 
+    # LM-head gating (serve prefill): set by the InferenceManager on the
+    # node producing the serve graph's logits.  When the step's batch config
+    # is a PrefillBatchConfig carrying ``logit_slots``, lower() gathers those
+    # <= max_requests hidden rows BEFORE the GEMM — mid-prompt chunks skip
+    # the [max_tokens, vocab] logits entirely; final chunks compute exactly
+    # each request's last-token row (gather-then-GEMM is row-wise identical
+    # to GEMM-then-gather, the bit-identity tests/test_prefill_gating.py
+    # pins).  ``cost_logit_rows`` feeds the same gating into the cost model
+    # (flops / plan_memory_bytes), so the serve search prices the gated
+    # program, not the ungated one.
+    lm_head_gated: bool = False
+    cost_logit_rows: Optional[int] = None
+
     def __init__(
         self,
         out_dim: int,
@@ -84,6 +97,15 @@ class Linear(Op):
 
     def lower(self, ctx, inputs, params):
         x = inputs[0]
+        if self.lm_head_gated:
+            slots = getattr(ctx.extras.get("batch_config"), "logit_slots",
+                            None)
+            if slots is not None:
+                # gather-then-GEMM: [T, E] -> [R, E]; -1 (no sample point in
+                # this chunk) clamps to row 0 — its logits are junk and the
+                # RequestManager never reads them (InferenceResult arrays
+                # are indexed by slot on the gated path)
+                x = jnp.take(x, jnp.clip(slots, 0, x.shape[0] - 1), axis=0)
         kernel = params["kernel"]
         if kernel.dtype == jnp.int8:
             # weight-only int8 (reference: Linear's serve quantization
@@ -152,6 +174,18 @@ class Linear(Op):
     def flops(self, in_specs):
         x = in_specs[0]
         batch = int(np.prod(x.shape[:-1]))
+        if self.cost_logit_rows is not None:
+            # LM-head gating: the serve prefill program computes at most
+            # cost_logit_rows (= max_requests) logit rows per chunk.  The
+            # search simulates ONE step at max_tokens — the prefill-shaped
+            # chunk, which is where the LM head's cost decides anything —
+            # so that program is the one to price.  Decode programs run
+            # ungated but their batch is max_requests tokens, where min()
+            # is a no-op; only a hypothetical full-logits step at
+            # max_tokens >> max_requests is underpriced here (capacity
+            # accounting deliberately ignores this field: see
+            # plan_memory_bytes).
+            batch = min(batch, self.cost_logit_rows)
         return 2 * batch * x.shape[-1] * self.out_dim
 
 

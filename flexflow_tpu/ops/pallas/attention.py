@@ -262,21 +262,57 @@ def decode_attention(
 
 
 # Prefill streams K+V blocks against a Bq*gq-row query tile; the block
-# budget is tighter than decode's because the scores tile [KV, Bq*gq, Bs]
-# and the q/o/acc tiles also live in VMEM.
+# budget is tighter than decode's because the scores tile and the q/o/acc
+# tiles also live in VMEM.  The grid carries a KV-HEAD-CHUNK axis: each
+# grid step works on ``kv_chunk <= KV`` heads, so the f32 score/softmax
+# scratch is [kv_chunk, Bq*gq, Bs] — chunking the heads (heads are
+# independent softmaxes) is what lets the Q tile WIDEN (Bq up to 128 at
+# the 7B shape, where the unchunked [32, 128, 512] score tile alone blows
+# VMEM) without shrinking the seq block below the DMA-efficient size.
 _VMEM_BUDGET_PREFILL = 4 * 2**20
+# f32 working set per grid step (scores + acc + m/l scratch); 8 MB keeps
+# the shipped tile=64, KV=32, d=128 config admissible (measured compiling
+# on v5e at r5) and forces head-chunking beyond it.
+_VMEM_BUDGET_PREFILL_SCRATCH = 8 * 2**20
+
+
+def _prefill_plan(num_kv, d, itemsize, kv_quant, m_rows, block_s, s_len):
+    """(kv_chunk, block_s) for the prefill grid.
+
+    Chooses the widest kv-head chunk whose f32 score/softmax scratch
+    (``4 * kv_chunk * m_rows * (block_s + d + 256)`` bytes: scores/p tile +
+    acc + the two 128-lane m/l buffers) fits the scratch budget, fitting
+    the seq block under the K+V double-buffer budget (int8 scales ride the
+    same pipeline — :func:`_fit_block_s`) at each candidate width.  Wider
+    Q tiles (m_rows) therefore trade head-parallelism per grid step for
+    query rows, keeping total VMEM bounded.
+    """
+    kv_chunk = num_kv
+
+    def fit(kc):
+        return _fit_block_s(block_s, s_len, kc, d, itemsize, kv_quant,
+                            _VMEM_BUDGET_PREFILL)
+
+    bs = fit(kv_chunk)
+    while (kv_chunk > 1
+           and 4 * kv_chunk * m_rows * (bs + d + 256)
+           > _VMEM_BUDGET_PREFILL_SCRATCH):
+        # largest proper divisor (power-of-two head counts halve)
+        kv_chunk = max(c for c in range(1, kv_chunk) if kv_chunk % c == 0)
+        bs = fit(kv_chunk)
+    return kv_chunk, bs
 
 
 def _prefill_kernel(
     rows_ref,       # scalar prefetch: i32[G] cache row per tile
     pstart_ref,     # scalar prefetch: i32[G] first position in tile
     fmax_ref,       # scalar prefetch: i32[G] causal frontier (last position)
-    q_ref,          # [1, KV, M, D] tile queries, M = Bq*gq (b-major fold)
-    k_ref,          # [1, KV, Bs, D] cache K block (row rows[g], block s)
-    v_ref,          # [1, KV, Bs, D]
+    q_ref,          # [1, KC, M, D] tile queries, M = Bq*gq (b-major fold)
+    k_ref,          # [1, KC, Bs, D] cache K block (row rows[g], chunk kc,
+    v_ref,          # [1, KC, Bs, D]  seq block s)
     *rest,          # [ks_ref, vs_ref,] o_ref, m/l/acc scratch
     block_s: int,
-    num_kv: int,
+    num_kv: int,    # heads PER GRID STEP (= kv_chunk)
     gq: int,
     m_rows: int,
     scale: float,
@@ -287,8 +323,12 @@ def _prefill_kernel(
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
     g = pl.program_id(0)
-    s = pl.program_id(1)
-    last_s = pl.num_programs(1) - 1
+    # grid axis 1 is the kv-head chunk (independent softmaxes, so the
+    # m/l/acc scratch simply re-initializes at s == 0 of every chunk);
+    # axis 2 (seq) stays minor so the online-softmax state carries across
+    # a (tile, head-chunk)'s blocks
+    s = pl.program_id(2)
+    last_s = pl.num_programs(2) - 1
 
     @pl.when(s == 0)
     def _init():
@@ -344,7 +384,7 @@ def _prefill_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_s", "interpret")
+    jax.jit, static_argnames=("scale", "block_s", "kv_chunk", "interpret")
 )
 def prefill_attention(
     q: jax.Array,        # [G, Bq, QH, D] tile queries (RoPE applied)
@@ -354,6 +394,7 @@ def prefill_attention(
     pstart: jax.Array,   # i32[G] first token position per tile
     scale: float,
     block_s: int = 512,
+    kv_chunk: Optional[int] = None,
     interpret: bool = False,
     k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8-KV dequant
     v_scale: Optional[jax.Array] = None,  # scales (None = fp cache)
@@ -369,57 +410,74 @@ def prefill_attention(
     causal DMA clamp as decode; tiles fold into the query-group dim exactly
     like :func:`tree_attention_batched`.  ALiBi models use the gather
     fallback (serve/ops.py routes them there).
+
+    The grid's middle axis chunks the KV heads (``kv_chunk`` per step,
+    default from :func:`_prefill_plan`'s VMEM arithmetic): heads are
+    independent softmaxes, so chunking them caps the f32 score scratch and
+    admits a WIDER Q tile — at the 7B shape tile 128 with kv_chunk 16 and
+    256-position seq blocks, vs the old unchunked ceiling of tile 64 with
+    128-position blocks: half the grid rows AND 2x the bytes per DMA wait.
     """
     g, bq, qh, d = q.shape
     _, num_kv, s_len, _ = k_cache.shape
     gq = qh // num_kv
     m_rows = bq * gq
     kv_quant = k_scale is not None
-    block_s = _fit_block_s(block_s, s_len, num_kv, d,
-                           jnp.dtype(k_cache.dtype).itemsize, kv_quant,
-                           _VMEM_BUDGET_PREFILL)
+    plan_kc, plan_bs = _prefill_plan(
+        num_kv, d, jnp.dtype(k_cache.dtype).itemsize, kv_quant, m_rows,
+        block_s, s_len)
+    if kv_chunk is None:
+        kv_chunk = plan_kc
+        block_s = plan_bs
+    else:  # forced chunk (tests): still fit the seq block at that width
+        if num_kv % kv_chunk:
+            raise ValueError(f"kv_chunk {kv_chunk} must divide KV {num_kv}")
+        block_s = _fit_block_s(block_s, s_len, kv_chunk, d,
+                               jnp.dtype(k_cache.dtype).itemsize, kv_quant,
+                               _VMEM_BUDGET_PREFILL)
+    n_kc = num_kv // kv_chunk
     n_blocks = s_len // block_s
     # fold tiles into the query-group dim, b-major: row = b*gq + g'
     qr = q.reshape(g, bq, num_kv, gq, d).transpose(0, 2, 1, 3, 4) \
          .reshape(g, num_kv, m_rows, d)
     fmax = jnp.clip(pstart + bq - 1, 0, s_len - 1)
 
-    def kv_map(i, j, rows, pstart, fmax):
-        return (rows[i], 0, jnp.minimum(j, fmax[i] // block_s), 0)
+    def kv_map(i, kc, j, rows, pstart, fmax):
+        return (rows[i], kc, jnp.minimum(j, fmax[i] // block_s), 0)
 
     scale_specs, scale_args = _scale_plumbing(
-        kv_map, num_kv, block_s, k_scale, v_scale)
+        kv_map, kv_chunk, block_s, k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(g, n_blocks),
+        grid=(g, n_kc, n_blocks),
         in_specs=[
             pl.BlockSpec(
-                (1, num_kv, m_rows, d),
-                lambda i, j, rows, pstart, fmax: (i, 0, 0, 0),
+                (1, kv_chunk, m_rows, d),
+                lambda i, kc, j, rows, pstart, fmax: (i, kc, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
+                (1, kv_chunk, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, num_kv, block_s, d), kv_map, memory_space=pltpu.VMEM,
+                (1, kv_chunk, block_s, d), kv_map, memory_space=pltpu.VMEM,
             ),
             *scale_specs,
         ],
         out_specs=pl.BlockSpec(
-            (1, num_kv, m_rows, d),
-            lambda i, j, rows, pstart, fmax: (i, 0, 0, 0),
+            (1, kv_chunk, m_rows, d),
+            lambda i, kc, j, rows, pstart, fmax: (i, kc, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((num_kv, m_rows, 128), jnp.float32),
-            pltpu.VMEM((num_kv, m_rows, 128), jnp.float32),
-            pltpu.VMEM((num_kv, m_rows, d), jnp.float32),
+            pltpu.VMEM((kv_chunk, m_rows, 128), jnp.float32),
+            pltpu.VMEM((kv_chunk, m_rows, 128), jnp.float32),
+            pltpu.VMEM((kv_chunk, m_rows, d), jnp.float32),
         ],
     )
     kernel = functools.partial(
         _prefill_kernel,
-        block_s=block_s, num_kv=num_kv, gq=gq, m_rows=m_rows,
+        block_s=block_s, num_kv=kv_chunk, gq=gq, m_rows=m_rows,
         scale=float(scale), kv_quant=kv_quant,
     )
     out = pl.pallas_call(

@@ -200,6 +200,16 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
             else:
                 b = n * (p.spec.nbytes() // max(p.spec.size, 1))
             params += b * (4.0 if training and p.trainable else 1.0)
+        # NOTE on serve LM-head gating (Linear.cost_logit_rows): the gated
+        # prefill program materializes only cost_logit_rows logit rows, but
+        # this estimate deliberately does NOT take that discount — the SAME
+        # plan also compiles decode/mixed-step programs whose batches carry
+        # no ``logit_slots`` and still materialize the full
+        # [max_tokens, vocab] logits, and this function's contract is an
+        # upper bound over every program the plan can run (err HIGH: a
+        # wrong reject costs optimality, a wrong admit OOMs).  The gating
+        # discount lives in Linear.flops (a cost-model, not a capacity,
+        # concern).
         for spec, sh in zip(step.out_specs, step.out_shardings):
             acts.append(
                 _local_size(spec, sh, mesh) * (spec.nbytes() // max(spec.size, 1))

@@ -112,6 +112,12 @@ class IncMultiHeadSelfAttention(Op):
     # _commit() copies it into the committed cache.
     kv_dtype: Optional[str] = None
 
+    # set by the InferenceManager on the graph's FIRST attention op when the
+    # prefill software-pipelining prologue is recognized: lower() then takes
+    # q/k/v from ctx.extras["qkv0"] (the scan carry) when present instead of
+    # projecting — see project_qkv / InferenceManager._project_chunk0.
+    qkv0_consumer: bool = False
+
     def __init__(
         self,
         embed_dim: int,
@@ -248,12 +254,20 @@ class IncMultiHeadSelfAttention(Op):
                 "(run it through the InferenceManager)"
             )
         x = inputs[0]  # [T, E]
-        qkv_w = params["qkv"]
-        if qkv_w.dtype == jnp.int8:  # weight-only int8 (serve/quant.py)
-            from .quant import dequant
-
-            qkv_w = dequant(qkv_w, params["qkv_scale"], x.dtype)
-        q, k, v = self._project(x, qkv_w, params.get("qkv_bias"), bc)
+        # cross-chunk software pipelining (InferenceManager.prefill_scan):
+        # the FIRST attention op of the graph (qkv0_consumer, set by the
+        # manager when the embedding->norm->attention prologue is
+        # recognized) takes its q/k/v from the scan carry — the projection
+        # was issued during the PREVIOUS chunk's step, so its weight fetch
+        # can overlap that chunk's attention/MLP tail instead of stalling
+        # at the while-loop iteration boundary.  The carried values are
+        # computed by the same op lowers (_project_chunk0), so the paths
+        # are bit-identical.
+        pre = ctx.extras.get("qkv0") if self.qkv0_consumer else None
+        if pre is not None:
+            q, k, v = pre
+        else:
+            q, k, v = self.project_qkv(x, params, bc)
 
         if isinstance(bc, TreeVerifyBatchConfig):
             state = self._commit(state, bc)
@@ -282,6 +296,21 @@ class IncMultiHeadSelfAttention(Op):
             head = tuple(ctx.config.get("head", ())) if ctx.config else ()
             y = y + bias_once(params["o_bias"], head, ctx)
         return [y.astype(self.dtype)]
+
+    def project_qkv(self, x, params, bc):
+        """QKV projection (+ dequant + RoPE) for a step's flat tokens.
+
+        The first stage of :meth:`lower`, also called by the
+        InferenceManager's prefill software pipelining to issue the NEXT
+        chunk's layer-0 projection inside the current scan step — one
+        code path, so the pipelined and plain scans stay bit-identical.
+        """
+        qkv_w = params["qkv"]
+        if qkv_w.dtype == jnp.int8:  # weight-only int8 (serve/quant.py)
+            from .quant import dequant
+
+            qkv_w = dequant(qkv_w, params["qkv_scale"], x.dtype)
+        return self._project(x, qkv_w, params.get("qkv_bias"), bc)
 
     def _project(self, x, qkv_w, qkv_b, bc):
         base = bc.base if not isinstance(bc, BatchConfig) else bc

@@ -197,13 +197,20 @@ class RequestManager:
             seq_lens = np.zeros(self.im.max_requests, np.int32)
             for req in self._active():
                 seq_lens[req.slot] = req.prefill_offset + len(req.generated)
+            # LM-head gating: completing segments' sample points ride the
+            # chunk's logit_slots, the step computes logits ONLY there, and
+            # the result arrays are indexed by SLOT (shape [max_requests])
+            gate = bool(getattr(self.im, "gate_lm_head", False))
             pbc, last_flat = PrefillBatchConfig.build(
                 segments, seq_lens, tile,
                 max_tokens=self.im.max_tokens,
                 max_requests=self.im.max_requests,
+                gate_slots=[slot for slot, _ in sample_points]
+                if gate else None,
             )
             sample_points = [
-                (last_flat[slot], rid) for slot, rid in sample_points
+                (slot if gate else last_flat[slot], rid)
+                for slot, rid in sample_points
             ]
             return pbc, sample_points
 
@@ -366,8 +373,12 @@ class RequestManager:
         im = self.im
         tile = im.prefill_tile
         cap = im.max_tokens
+        gate = bool(getattr(im, "gate_lm_head", False))
         chunks: List = []  # per-chunk numpy field tuples (BatchConfig order)
-        points: List[Tuple[int, int, int]] = []  # (chunk_idx, flat_idx, rid)
+        ls_chunks: List = []  # per-chunk logit_slots (gated path)
+        # (chunk_idx, result_idx, rid): result_idx is the SLOT when gated
+        # (result arrays are [max_requests]), the flat token index otherwise
+        points: List[Tuple[int, int, int]] = []
         seq = np.zeros(im.max_requests, np.int32)
         for req in self._active():
             seq[req.slot] = req.prefill_offset + len(req.generated)
@@ -385,8 +396,13 @@ class RequestManager:
                     max_tokens=cap, max_requests=im.max_requests,
                 )
                 req.prefill_offset += take
-                if req.prefill_offset == len(req.prompt):
-                    points.append((len(chunks), last_flat[req.slot], req.rid))
+                done = req.prefill_offset == len(req.prompt)
+                if done:
+                    points.append((len(chunks),
+                                   req.slot if gate else last_flat[req.slot],
+                                   req.rid))
+                ls_chunks.append(PrefillBatchConfig.np_logit_slots(
+                    [req.slot] if done else [], last_flat, im.max_requests))
                 chunks.append(fields)
         # stack chunk fields host-side (ONE device transfer per field per
         # segment, not five tiny transfers per chunk) and scan in power-of-
@@ -401,6 +417,8 @@ class RequestManager:
                     for i in range(5)
                 )),
                 tile_size=tile,
+                logit_slots=jnp.asarray(np.stack(ls_chunks[at: at + seg]))
+                if gate else None,
             )
             outs.append((at, im.prefill_scan(stacked, self._sample_arg())))
             at += seg

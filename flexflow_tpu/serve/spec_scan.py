@@ -124,8 +124,12 @@ class SpecDecodeScan:
         self._node_depth = np.zeros(self.n_tree, np.int32)
         for lvl in range(1, self.depth + 1):
             self._node_depth[1 + (lvl - 1) * self.width: 1 + lvl * self.width] = lvl
+        from ..utils.platform import collective_safe_compiler_options
+
         self._scan = jax.jit(
-            self._scan_impl, donate_argnums=(2,), static_argnames=("n_macro",)
+            self._scan_impl, donate_argnums=(2,),
+            static_argnames=("n_macro",),
+            compiler_options=collective_safe_compiler_options(llm.model.mesh),
         )
 
     # ------------------------------------------------------------------

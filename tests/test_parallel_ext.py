@@ -15,6 +15,7 @@ from flexflow_tpu.compat import shard_map
 from flexflow_tpu.parallel.mesh import make_mesh
 from flexflow_tpu.parallel.pipeline import pipeline_apply, pipeline_train_step
 from flexflow_tpu.parallel.ring_attention import ring_attention
+from flexflow_tpu.utils.platform import collective_safe_compiler_options
 
 
 def full_attention(q, k, v, causal, scale):
@@ -43,7 +44,11 @@ def test_ring_attention_matches_full(causal):
             mesh=mesh,
             in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
             out_specs=P(None, "sp"),
-        )
+        ),
+        # the collective-rendezvous deadlock class (see conftest): tests
+        # that jit collective programs DIRECTLY scope the sequential CPU
+        # schedule here, like the library jit sites do
+        compiler_options=collective_safe_compiler_options(mesh),
     )(q, k, v)
     want = full_attention(q, k, v, causal, scale)
     np.testing.assert_allclose(np.asarray(ringed), np.asarray(want),
@@ -103,7 +108,8 @@ def test_pipeline_apply_matches_sequential():
             mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P("pp"), params), P()),
             out_specs=P(),
-        )
+        ),
+        compiler_options=collective_safe_compiler_options(mesh),
     )(params, x)
 
     want = x
@@ -130,7 +136,9 @@ def test_pipeline_train_step_grads_match_sequential():
     # pp=2 x dp=4 over 8 devices
     mesh = make_mesh({"pp": n_stages, "dp": 4}, jax.devices()[:8])
     step = pipeline_train_step(stage_mlp, loss_fn, mesh, "pp", dp_axis="dp")
-    loss, grads = jax.jit(step)(params, x, labels)
+    loss, grads = jax.jit(
+        step, compiler_options=collective_safe_compiler_options(mesh),
+    )(params, x, labels)
 
     def ref_loss(p):
         y = x

@@ -125,7 +125,13 @@ def test_searched_partition_executes_via_gpipe():
     step = pipeline_train_step(stage, loss_fn, mesh, "pp", dp_axis="dp")
     xs = jnp.asarray(rng.randn(n_micro, mb, width), jnp.float32)
     labs = jnp.asarray(rng.randn(n_micro, mb, width), jnp.float32)
-    loss, grads = jax.jit(step)({"w": w, "b": b}, xs, labs)
+    from flexflow_tpu.utils.platform import collective_safe_compiler_options
+
+    # direct jit of a pp-ppermute collective program: scope the sequential
+    # CPU schedule here like the library jit sites (see tests/conftest.py)
+    loss, grads = jax.jit(
+        step, compiler_options=collective_safe_compiler_options(mesh),
+    )({"w": w, "b": b}, xs, labs)
     assert np.isfinite(float(loss))
     assert jax.tree.all(
         jax.tree.map(lambda g: bool(jnp.isfinite(g).all()), grads))

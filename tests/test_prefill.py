@@ -22,13 +22,15 @@ from test_pallas_attention import ref_attention
 from test_serve import TINY, make_im, ref_greedy_decode
 
 
-@pytest.mark.parametrize("qh,kv,d,s,bq,block", [
-    (4, 2, 8, 64, 8, 16),    # GQA, multi-tile
-    (4, 4, 8, 32, 4, 32),    # MHA, single seq block
-    (8, 1, 16, 64, 16, 16),  # MQA, whole-chunk tile
-    (4, 2, 8, 40, 4, 16),    # non-dividing seq len -> gcd'd block
+@pytest.mark.parametrize("qh,kv,d,s,bq,block,kv_chunk", [
+    (4, 2, 8, 64, 8, 16, None),    # GQA, multi-tile
+    (4, 4, 8, 32, 4, 32, None),    # MHA, single seq block
+    (8, 1, 16, 64, 16, 16, None),  # MQA, whole-chunk tile
+    (4, 2, 8, 40, 4, 16, None),    # non-dividing seq len -> gcd'd block
+    (4, 4, 8, 64, 8, 16, 2),       # KV-HEAD-CHUNKED grid (r6 wide-tile axis)
+    (4, 2, 8, 64, 8, 16, 1),       # one head per grid step
 ])
-def test_prefill_kernel_matches_reference(qh, kv, d, s, bq, block):
+def test_prefill_kernel_matches_reference(qh, kv, d, s, bq, block, kv_chunk):
     """Per-slot equality vs the gather formulation, pads included: the
     kernel reconstructs every slot's position as pstart + b, so comparing
     against ref_attention at those same positions checks all rows."""
@@ -42,7 +44,7 @@ def test_prefill_kernel_matches_reference(qh, kv, d, s, bq, block):
     pstart = jnp.asarray([5, 0, s - bq], jnp.int32)  # mid / start / end
     scale = 1.0 / np.sqrt(d)
     got = prefill_attention(q, kc, vc, rows, pstart, scale,
-                            block_s=block, interpret=True)
+                            block_s=block, kv_chunk=kv_chunk, interpret=True)
     flat_rows = jnp.repeat(rows, bq)
     flat_pos = (pstart[:, None] + jnp.arange(bq)[None, :]).reshape(-1)
     flat_pos = jnp.clip(flat_pos, 0, s - 1)

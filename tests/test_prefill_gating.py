@@ -1,0 +1,301 @@
+"""LM-head gating + prefill software pipelining: bit-identity with the
+full-logits path (ISSUE r6 tentpole).
+
+Gating claims the GATHERED final-position rows see exactly the logits the
+ungated program computes (gather-then-GEMM == GEMM-then-gather row-wise);
+pipelining claims the carried layer-0 q/k/v equal the in-graph projection.
+Both are exact-equality claims, so the tests compare token ids AND the
+result's logit views (logits_max, topk log-probs) with array_equal, plus
+the KV caches the step writes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.serve import (
+    GenerationConfig,
+    RequestManager,
+)
+from flexflow_tpu.serve.batch_config import BatchConfig, PrefillBatchConfig
+
+from test_serve import TINY, make_im, ref_greedy_decode
+
+
+def _stack_chunks(im, prompt, slot=0, gate=True):
+    """Stacked multi-chunk PrefillBatchConfig for one request (the
+    _prefill_stretch layout), returning (stacked, n_chunks, sample_idx)."""
+    tile = im.prefill_tile
+    cap = im.max_tokens
+    fields_l, ls_l = [], []
+    at = 0
+    while at < len(prompt):
+        take = min((cap // tile) * tile, len(prompt) - at)
+        seq = np.zeros(im.max_requests, np.int32)
+        seq[slot] = at + take
+        fields, last_flat = PrefillBatchConfig.np_fields(
+            [(slot, prompt[at: at + take], at)], seq, tile,
+            max_tokens=cap, max_requests=im.max_requests,
+        )
+        done = at + take == len(prompt)
+        ls_l.append(PrefillBatchConfig.np_logit_slots(
+            [slot] if done else [], last_flat, im.max_requests))
+        if done:
+            sample_idx = slot if gate else last_flat[slot]
+        fields_l.append(fields)
+        at += take
+    stacked = PrefillBatchConfig(
+        base=BatchConfig(*(
+            jnp.asarray(np.stack([f[i] for f in fields_l]))
+            for i in range(5)
+        )),
+        tile_size=tile,
+        logit_slots=jnp.asarray(np.stack(ls_l)) if gate else None,
+    )
+    return stacked, len(fields_l), sample_idx
+
+
+def test_gated_step_bit_identical_to_full_logits():
+    """One gated prefill chunk vs the same chunk ungated: the sample
+    point's token id, max logit and top-k log-probs must be IDENTICAL,
+    and the caches written must match bit-for-bit."""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=32, use_pallas=True,
+                 topk=4)
+    prompt = [5, 9, 2, 11, 3]
+    pbc_u, last = PrefillBatchConfig.build(
+        [(0, prompt, 0)], [len(prompt)], im.prefill_tile,
+        max_tokens=8, max_requests=2,
+    )
+    r_u = im.step(pbc_u)
+    k_u = {n: np.asarray(b["k"]) for n, b in im.state.items()}
+    im.reset()
+    pbc_g, last_g = PrefillBatchConfig.build(
+        [(0, prompt, 0)], [len(prompt)], im.prefill_tile,
+        max_tokens=8, max_requests=2, gate_slots=[0],
+    )
+    assert last_g == last
+    assert np.asarray(pbc_g.logit_slots).tolist() == [last[0], -1]
+    r_g = im.step(pbc_g)
+    # gated result arrays are [max_requests], indexed by slot
+    assert r_g.token_ids.shape[0] == im.max_requests
+    fu = last[0]
+    np.testing.assert_array_equal(
+        np.asarray(r_g.token_ids)[0], np.asarray(r_u.token_ids)[fu])
+    np.testing.assert_array_equal(
+        np.asarray(r_g.logits_max)[0], np.asarray(r_u.logits_max)[fu])
+    np.testing.assert_array_equal(
+        np.asarray(r_g.topk_ids)[0], np.asarray(r_u.topk_ids)[fu])
+    np.testing.assert_array_equal(
+        np.asarray(r_g.topk_logprobs)[0], np.asarray(r_u.topk_logprobs)[fu])
+    for n, b in im.state.items():  # gating is post-attention: caches equal
+        np.testing.assert_array_equal(np.asarray(b["k"]), k_u[n])
+
+
+def test_gated_generation_matches_ungated_and_golden():
+    """Full serving (multi-chunk prefill stretch + decode) with gating on
+    (default) vs off: identical generations, both equal to the independent
+    full-context reference."""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=64, use_pallas=True)
+    assert im.gate_lm_head and im.prefill_overlap
+    prompts = [[5, 9, 2, 11, 3, 7, 1, 4, 9, 13], [4, 4, 8]]
+    try:
+        out_gated = RequestManager(
+            im, GenerationConfig(max_new_tokens=4)).generate(prompts)
+        im.reset()
+        im.gate_lm_head = False
+        out_full = RequestManager(
+            im, GenerationConfig(max_new_tokens=4)).generate(prompts)
+    finally:
+        im.gate_lm_head = True
+    assert out_gated == out_full
+    for prompt, got in zip(prompts, out_gated):
+        assert got == ref_greedy_decode(im.params, TINY, prompt, 4)
+
+
+def test_gated_step_int8_kv_matches_full_logits():
+    """int8-KV variant of the bit-identity claim: gating is downstream of
+    the quantize-on-write attention, so the gathered final-position logits
+    and the quantized caches must match the ungated int8 step exactly.
+    (Gated int8 GENERATION vs the fp golden is covered by
+    test_kv_int8.py's pallas-vs-flat test, which now runs gated by
+    default; this config reuses its cached InferenceManager.)"""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=32, use_pallas=True,
+                 kv_dtype="int8")
+    prompt = [5, 9, 2, 11, 3]
+    pbc_u, last = PrefillBatchConfig.build(
+        [(0, prompt, 0)], [len(prompt)], im.prefill_tile,
+        max_tokens=8, max_requests=2,
+    )
+    r_u = im.step(pbc_u)
+    cache_u = {n: {k: np.asarray(v) for k, v in b.items()}
+               for n, b in im.state.items()}
+    im.reset()
+    pbc_g, _ = PrefillBatchConfig.build(
+        [(0, prompt, 0)], [len(prompt)], im.prefill_tile,
+        max_tokens=8, max_requests=2, gate_slots=[0],
+    )
+    r_g = im.step(pbc_g)
+    fu = last[0]
+    np.testing.assert_array_equal(
+        np.asarray(r_g.token_ids)[0], np.asarray(r_u.token_ids)[fu])
+    np.testing.assert_array_equal(
+        np.asarray(r_g.logits_max)[0], np.asarray(r_u.logits_max)[fu])
+    for n, b in im.state.items():  # int8 values AND f32 scales identical
+        for key in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(np.asarray(b[key]),
+                                          cache_u[n][key])
+
+
+def test_gated_mixed_decode_prefill_step():
+    """A request arriving mid-decode forces mixed flat steps (never gated)
+    between gated pure-prefill steps; the interleaving must still match
+    the golden and the ungated run."""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=64, use_pallas=True)
+    gen = GenerationConfig(max_new_tokens=6)
+
+    def serve(gate):
+        im.reset()
+        im.gate_lm_head = gate
+        rm = RequestManager(im, gen)
+        rm.register_new_request([3, 11, 25, 40])  # prefills, then decodes
+        bc, pts = rm.prepare_next_batch()
+        assert isinstance(bc, PrefillBatchConfig)
+        assert (bc.logit_slots is not None) == gate
+        rm.process_result(im.step(bc), pts)
+        rid_b = rm.register_new_request([(i % 7) + 1 for i in range(19)])
+        saw_mixed = False
+        while rm.has_work():
+            bc, pts = rm.prepare_next_batch()
+            if isinstance(bc, BatchConfig):
+                saw_mixed = True  # decode+prefill mix rides the flat path
+            rm.process_result(im.step(bc), pts)
+        assert saw_mixed
+        return [rm.requests[rid].generated for rid in (0, rid_b)]
+
+    try:
+        gated = serve(True)
+        ungated = serve(False)
+    finally:
+        im.gate_lm_head = True
+    assert gated == ungated
+    assert gated[1] == ref_greedy_decode(
+        im.params, TINY, [(i % 7) + 1 for i in range(19)], 6)
+
+
+def test_prefill_overlap_scan_bit_identical():
+    """The software-pipelined prefill scan (layer-0 QKV carried across the
+    lax.scan boundary) must emit the same tokens and write the same caches
+    as the plain scan — the carried projection reuses the op lowers."""
+    im = make_im(max_tokens=8, max_requests=2, max_seq=64, use_pallas=True)
+    assert im._overlap_steps is not None
+    prompt = [(i * 5) % 50 + 1 for i in range(24)]  # 3 chunks of 8
+    stacked, n_chunks, si = _stack_chunks(im, prompt, gate=True)
+    assert n_chunks == 3
+    try:
+        im.prefill_overlap = True
+        toks_ov = np.asarray(im.prefill_scan(stacked))
+        k_ov = {n: np.asarray(b["k"]) for n, b in im.state.items()}
+        im.reset()
+        im.prefill_overlap = False
+        toks_pl = np.asarray(im.prefill_scan(stacked))
+    finally:
+        im.prefill_overlap = True
+    np.testing.assert_array_equal(toks_ov, toks_pl)
+    for n, b in im.state.items():
+        np.testing.assert_array_equal(np.asarray(b["k"]), k_ov[n])
+    # and the emitted first token matches the golden continuation
+    want = ref_greedy_decode(im.params, TINY, prompt, 1)
+    assert int(toks_ov[-1, si]) == want[0]
+
+
+def test_overlap_detection_scopes_to_llama_prologue():
+    """Graphs whose prologue is not embedding->rms_norm->attention (OPT
+    inserts a position embedding) must auto-disable the pipelining and
+    still serve correctly through the plain scan."""
+    from flexflow_tpu.serve import ServeModelConfig
+
+    opt_cfg = ServeModelConfig(
+        model_type="opt", vocab_size=67, hidden_size=32,
+        intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64,
+    )
+    im = make_im(max_tokens=8, max_requests=2, max_seq=32, use_pallas=True,
+                 cfg=opt_cfg)
+    assert im._overlap_steps is None and not im.prefill_overlap
+    out = RequestManager(im, GenerationConfig(max_new_tokens=2)).generate(
+        [[5, 9, 2, 11, 3]])
+    assert len(out[0]) == 2
+
+
+def test_gated_build_contract():
+    pbc, last = PrefillBatchConfig.build(
+        [(0, [1, 2, 3], 0), (1, [4, 5, 6, 7, 8], 12)],
+        [3, 17], tile_size=4, max_tokens=16, max_requests=4,
+        gate_slots=[1],
+    )
+    # only slot 1 completes: slot 0's chunk is mid-prompt (-1)
+    assert np.asarray(pbc.logit_slots).tolist() == [-1, last[1], -1, -1]
+    ungated, _ = PrefillBatchConfig.build(
+        [(0, [1, 2, 3], 0)], [3], tile_size=4, max_tokens=16, max_requests=4,
+    )
+    assert ungated.logit_slots is None
+
+
+def test_gate_flag_requires_marked_lm_head():
+    """Flipping im.gate_lm_head = True on a manager whose LM head was
+    never marked (gate_lm_head=False at construction) must stay
+    ineffective: the RequestManager would otherwise build slot-indexed
+    gated batches an unmarked Linear ignores, silently corrupting every
+    request's sample points."""
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.mesh import make_mesh
+    from flexflow_tpu.serve import InferenceManager, build_model
+
+    ff = FFModel(FFConfig(), mesh=make_mesh({"tp": 1}, jax.devices()[:1]))
+    build_model(ff, TINY, 8)
+    im = InferenceManager(ff, max_requests=2, max_tokens_per_batch=8,
+                          max_seq_len=32, gate_lm_head=False)
+    assert not im.gate_lm_head
+    im.gate_lm_head = True  # the ablation toggle the docstring invites
+    assert not im.gate_lm_head  # property ANDs in the construction mark
+    # and a normally-constructed manager really is gated + togglable
+    im2 = make_im(max_tokens=8, max_requests=2, max_seq=64, use_pallas=True)
+    assert im2.gate_lm_head
+    try:
+        im2.gate_lm_head = False
+        assert not im2.gate_lm_head
+    finally:
+        im2.gate_lm_head = True
+
+
+def test_bench_prefill_fields_survive_merge():
+    """The r6 ablation/sweep fields must reach the bench artifact: the
+    merge is whitelist-free by construction (ttft_fields), and bench_ttft
+    really computes the keys — the perturbation_regret drop (VERDICT r5
+    weak #1) must not recur for the prefill section."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    payload = {
+        "ttft_ms": 1.0,
+        "prefill_mfu": 0.6,
+        "prefill_ablation": {"gating_off_tokens_per_sec": 1.0,
+                             "overlap_off_tokens_per_sec": 2.0},
+        "prefill_cap_sweep": {"256": 1.0, "512": 2.0},
+    }
+    doc = {}
+    out = bench.ttft_fields(doc, dict(payload))
+    for k, v in payload.items():
+        assert out[k] == v
+    with open(bench.__file__) as f:
+        src = f.read()
+    assert '"prefill_ablation"' in src and '"prefill_cap_sweep"' in src
+    assert "ttft_fields(doc, bench_ttft" in src  # the section uses the merge
