@@ -22,6 +22,11 @@ def main():
     ap.add_argument("--cpu", type=int, default=0,
                     help="force N virtual CPU devices (0 = real TPU)")
     ap.add_argument("--tp", type=int, default=1, help="tensor-parallel degree")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline-parallel stages (stage-split serving; "
+                         "composes with --tp, needs pp*tp devices)")
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="decode micro-batches per macro-step (0 = pp)")
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--heads", type=int, default=8)
@@ -63,17 +68,36 @@ def main():
         num_attention_heads=args.heads,
         num_key_value_heads=args.kv_heads,
     )
-    mesh = make_mesh({"tp": args.tp}, jax.devices()[: args.tp])
-    ff = FFModel(FFConfig(), mesh=mesh)
-    logits = build_model(ff, cfg, args.max_tokens)
-    im = InferenceManager(
-        ff,
-        max_requests=args.max_requests,
-        max_tokens_per_batch=args.max_tokens,
-        max_seq_len=args.max_seq,
-        outputs=logits,
-        kv_dtype=args.kv_dtype,
-    )
+    if args.pp > 1:
+        from flexflow_tpu.serve import PipelinedInferenceManager
+
+        mesh = make_mesh({"pp": args.pp, "tp": args.tp},
+                         jax.devices()[: args.pp * args.tp])
+        ff = FFModel(FFConfig(), mesh=mesh)
+        logits = build_model(ff, cfg, args.max_tokens)
+        im = PipelinedInferenceManager(
+            ff,
+            max_requests=args.max_requests,
+            max_tokens_per_batch=args.max_tokens,
+            max_seq_len=args.max_seq,
+            n_micro=args.microbatches or None,
+            outputs=logits,
+            kv_dtype=args.kv_dtype,
+        )
+        gb = [round(b / 1e9, 3) for b in im.stage_memory_bytes()]
+        print(f"pp{args.pp} x tp{args.tp}: per-stage plan GB {gb}")
+    else:
+        mesh = make_mesh({"tp": args.tp}, jax.devices()[: args.tp])
+        ff = FFModel(FFConfig(), mesh=mesh)
+        logits = build_model(ff, cfg, args.max_tokens)
+        im = InferenceManager(
+            ff,
+            max_requests=args.max_requests,
+            max_tokens_per_batch=args.max_tokens,
+            max_seq_len=args.max_seq,
+            outputs=logits,
+            kv_dtype=args.kv_dtype,
+        )
     im.init_operators_inference(rng=jax.random.PRNGKey(0))
     rm = RequestManager(im, GenerationConfig(max_new_tokens=args.max_new_tokens))
 

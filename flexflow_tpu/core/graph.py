@@ -172,3 +172,38 @@ class Graph:
             ins = ", ".join(f"t{t}" for t in node.inputs)
             lines.append(f"  {node.name}: ({ins}) -> {outs}")
         return "Graph(\n" + "\n".join(lines) + "\n)"
+
+
+def live_cuts(graph: "Graph", final_tids: Sequence[int]) -> List[frozenset]:
+    """Per-boundary live tensor sets: the cut-tracking core of the SESE
+    segment machinery (``FFModel._pipeline_segments`` uses it for the GPipe
+    training executor; the serve stage split uses it for pipeline-parallel
+    serving).
+
+    ``live_cuts(g, finals)[i]`` is the set of tensor ids produced at or
+    before node ``i`` (graph inputs included) that are still needed strictly
+    after it — consumed by a later node, or listed in ``final_tids`` (the
+    protected outputs).  A boundary whose live set is small is a cheap
+    pipeline cut: only those tensors cross between stages.  A single-tensor
+    live set is exactly the SESE (single-entry/single-exit) segment boundary
+    the training pipeline carves at; serve graphs with fused residual
+    norms carry ``{residual, hidden}`` between decoder layers, so their
+    natural cuts are two tensors wide.
+    """
+    nodes = graph.nodes
+    keep = set(final_tids)
+    last_use: Dict[int, int] = {}
+    for i, node in enumerate(nodes):
+        for t in node.inputs:
+            last_use[t] = i
+    live = {t for t in graph.input_tids if last_use.get(t) is not None}
+    out: List[frozenset] = []
+    for i, node in enumerate(nodes):
+        for t in node.inputs:
+            if last_use.get(t) == i and t not in keep:
+                live.discard(t)
+        for t in node.outputs:
+            if last_use.get(t, -1) > i or t in keep:
+                live.add(t)
+        out.append(frozenset(live))
+    return out

@@ -158,9 +158,16 @@ def _constrain_spmd(x: jax.Array, sh: TensorSharding, mesh: Mesh) -> jax.Array:
 # parameter initialization & placement
 # ---------------------------------------------------------------------------
 def init_params(
-    graph, plan: Plan, rng: jax.Array, dtype=None
+    graph, plan: Plan, rng: jax.Array, dtype=None, only=None
 ) -> Dict[str, Dict[str, jax.Array]]:
-    """Initialize all node params as global arrays placed per plan shardings."""
+    """Initialize all node params as global arrays placed per plan shardings.
+
+    ``only``: optional set of node names to materialize.  The per-param rng
+    key index still advances over EVERY node of ``graph`` in order, so a
+    stage-split model (pipeline-parallel serving initializes each stage
+    against its own sub-plan) draws bit-identical weights to the
+    single-plan initialization with the same seed.
+    """
     from ..training.initializer import default_initializer_for
 
     mesh = plan.mesh
@@ -169,6 +176,9 @@ def init_params(
     for node in graph.nodes:
         ps = node.op.params()
         if not ps:
+            continue
+        if only is not None and node.name not in only:
+            i += len(ps)
             continue
         sub = {}
         for p in ps:

@@ -640,31 +640,24 @@ class FFModel:
         ``exit[i] == entry[i+1]``, ``entry[0]`` is the graph input, and
         ``exit[-1]`` is the last node's final output (the protected logits).
         """
+        from .core.graph import live_cuts
+
         g = self.graph
         if len(g.input_tids) != 1:
             return None, "graph has multiple inputs"
         nodes = g.nodes
         if not nodes:
             return None, "empty graph"
-        last_use = {}
-        for i, node in enumerate(nodes):
-            for t in node.inputs:
-                last_use[t] = i
         final_tid = nodes[-1].outputs[-1]
+        lives = live_cuts(g, [final_tid])
         segments = []
         cur = []
         entry = g.input_tids[0]
-        live = {entry} if last_use.get(entry) is not None else set()
         for i, node in enumerate(nodes):
             cur.append(node)
-            for t in node.inputs:
-                if last_use.get(t) == i:
-                    live.discard(t)
-            for t in node.outputs:
-                if last_use.get(t, -1) > i or t == final_tid:
-                    live.add(t)
+            live = lives[i]
             if i == len(nodes) - 1:
-                if live != {final_tid}:
+                if set(live) != {final_tid}:
                     return None, (
                         "graph's final live set is not the single protected "
                         f"output ({len(live)} tensors live at the end)"

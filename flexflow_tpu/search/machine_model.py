@@ -126,6 +126,18 @@ class MachineModel:
         )
 
     # ---- communication ------------------------------------------------
+    def transfer_time(self, nbytes: float, axes=()) -> float:
+        """Point-to-point device-to-device transfer time (the inter-stage
+        activation hop of pipeline-parallel serving: collective-permute /
+        ICI copy between adjacent stage slices).  ``axes``: mesh axes the
+        hop crosses — listed in ``dcn_axes`` means the slower DCN path."""
+        if nbytes <= 0:
+            return 0.0
+        on_dcn = any(a in self.dcn_axes for a in axes)
+        bw = self.spec.dcn_bandwidth if on_dcn else self.spec.ici_bandwidth
+        lat = self.spec.dcn_latency if on_dcn else self.spec.ici_latency
+        return nbytes / bw + lat
+
     def collective_time(self, comm_bytes_per_device: float, axes, mesh) -> float:
         """Ring-model time for a collective moving ``comm_bytes_per_device``
         over the given mesh axes (the per-op ``comm_bytes`` hook supplies the

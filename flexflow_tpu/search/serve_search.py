@@ -1,0 +1,241 @@
+"""Joint TP x PP serve search: price stage-split decode under the HBM cap.
+
+SURVEY §4's inference matrix is "model x precision x TP/PP configs"; Unity
+(OSDI'22) searches joint parallelization including pipeline stages.  This
+module extends the calibrated serve search to that axis: every (tp, pp)
+factorization of the chip budget is stage-split with the same machinery the
+executor uses (``serve.pp.serve_stage_split`` / ``build_stage_plans``), gated
+by PER-STAGE ``plan_memory_bytes`` against the per-chip HBM capacity, and
+priced with a decode cost model that accounts for what the generic
+``simulate`` cannot see:
+
+* **weight re-streaming per micro-batch** — decode is weight-bandwidth-bound
+  and every micro-batch through a stage re-reads that stage's weights, so
+  micro-batching trades bubble fraction against weight traffic;
+* **KV-prefix streaming** — each request's causally-live cache rows move once
+  per macro-step regardless of micro-batch count;
+* **inter-stage activation transfer** — one boundary hop per micro-batch per
+  adjacent stage pair (``MachineModel.transfer_time``);
+* **the pipeline bubble** — steady-state decode re-services a micro-batch
+  every ``max(m, pp)`` ticks: below ``m = pp`` stages idle ``(pp-m)/pp``
+  of the time, at ``m = pp`` the pipeline is full, and ``m > pp`` buys no
+  bubble win while re-streaming stage weights (see :func:`pp_serve_cost`).
+
+The returned plan is what ``PipelinedInferenceManager`` executes; the search
+and the executor share the stage split, so "fits per stage" means the same
+thing in both places.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .machine_model import MachineModel
+from .simulator import (
+    HEAVY_OPS,
+    _step_flops,
+    _step_param_bytes,
+    plan_memory_bytes,
+    step_state_bytes,
+)
+
+_KV_BUFS = frozenset({"k", "v", "k_scale", "v_scale"})
+
+
+def _stage_kv_bytes(plan) -> float:
+    """Local committed-KV bytes (k/v + int8 scales) of a stage plan — the
+    per-macro-step cache read bound (err-high: counts the full registered
+    capacity, not the instantaneous live prefix, consistent with
+    ``plan_memory_bytes``'s reject-safe contract)."""
+    return sum(
+        step_state_bytes(step, plan.mesh, names=_KV_BUFS)
+        for step in plan.steps if not step.is_parallel
+    )
+
+
+def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
+                  boundary_bytes: float = 0.0, pp_axes=()) -> Dict:
+    """Simulated STEADY-STATE decode cost for a stage-split serve plan.
+
+    The graph's flat batch (``R_tot`` concurrent decode slots) splits into
+    ``m = n_micro`` micro-batches that cycle through the ``S`` stages
+    continuously — the multi-step decode scan never drains between tokens,
+    so a micro-batch is re-serviced every ``max(m, S)`` ticks:
+
+    * tick (one micro-batch through the bottleneck stage):
+      ``W_stage/bw + (flops/mxu + KV/bw + tp_comm)/m + step_overhead + hop``
+      — the stage's WEIGHTS re-stream for every micro-batch, while the
+      macro-batch's flops / causally-live KV / TP collectives split 1/m
+      per micro-batch; ``hop`` is the inter-stage boundary transfer
+      (``MachineModel.transfer_time``, one handoff per tick on the
+      critical path).
+    * per-request TPOT = ``max(m, S) * tick``: with ``m >= S`` the pipeline
+      is full and PP is latency-neutral capacity scaling (TPOT ~= the
+      single-chip step at the same total concurrency, with 1/S of the
+      weights+KV per chip); with ``m < S`` stages idle
+      ``(S - m)/S`` of the time — the decode bubble.  Fill/drain costs
+      ``(S-1)`` extra ticks once per scan, amortized over its length
+      (not counted here).
+
+    Returns ``{tpot_s, tick_s, bubble_frac, transfer_s, stage_ticks}``.
+    """
+    spec = machine.spec
+    ticks: List[float] = []
+    for plan in stage_plans:
+        mesh = plan.mesh
+        w = fl = comm = 0.0
+        for step in plan.steps:
+            if step.is_parallel:
+                op = step.node.op
+                b = op.comm_bytes(step.in_specs[0], step.in_shardings[0],
+                                  mesh)
+                comm += machine.collective_time(
+                    b, getattr(op, "axes", ()), mesh)
+                continue
+            w += _step_param_bytes(step, plan, mesh)
+            if step.node.op.type_name in HEAVY_OPS:
+                fl += _step_flops(step, mesh)
+        kv = _stage_kv_bytes(plan)
+        tick = (
+            w / spec.hbm_bandwidth
+            + (fl / (spec.peak_flops_bf16 * spec.mxu_efficiency)
+               + kv / spec.hbm_bandwidth + comm) / n_micro
+            + spec.step_overhead
+        )
+        ticks.append(tick)
+    s = len(stage_plans)
+    hop = machine.transfer_time(boundary_bytes / max(n_micro, 1), pp_axes) \
+        if s > 1 else 0.0
+    tick = max(ticks) + hop
+    tpot = max(n_micro, s) * tick
+    return {
+        "tpot_s": tpot,
+        "tick_s": tick,
+        "bubble_frac": max(0, s - n_micro) / s,
+        "transfer_s": hop,
+        "stage_ticks": ticks,
+    }
+
+
+def _boundary_bytes(graph, split) -> float:
+    """Worst-case bytes crossing a stage boundary (full macro-batch): the
+    widest exit live set's tensor bytes."""
+    import jax.numpy as jnp
+
+    worst = 0.0
+    for _, _, exit_tids in split[:-1]:
+        b = sum(
+            graph.spec(t).size * jnp.dtype(graph.spec(t).dtype).itemsize
+            for t in exit_tids
+        )
+        worst = max(worst, b)
+    return worst
+
+
+def search_serve_plan(
+    model,
+    n_chips: int,
+    machine: Optional[MachineModel] = None,
+    hbm_cap: Optional[float] = None,
+    n_micro: Sequence[int] = (1, 2, 4),
+    devices=None,
+    spec_name: Optional[str] = None,
+) -> Dict:
+    """Pick the best (tp, pp, n_micro) for serving ``model``'s graph on
+    ``n_chips`` chips.
+
+    The graph must already carry its serve capacities
+    (``register_serve_capacities`` — InferenceManager/PipelinedInferenceManager
+    do this in ``__init__``; callers searching BEFORE building a manager call
+    it directly) and any int8 annotations (``annotate_int8``), so per-stage
+    ``plan_memory_bytes`` prices the deployment's real buffers.
+
+    Every tp x pp = n_chips factorization whose tp divides the attention
+    kv-heads is stage-split, memory-gated PER STAGE against ``hbm_cap``
+    (default: the machine spec's per-chip HBM), and priced by
+    :func:`pp_serve_cost` at each micro-batch count.  Returns the best
+    admissible plan plus the full candidate table::
+
+        {"tp", "pp", "n_micro", "tpot_ms", "bubble_frac", "transfer_ms",
+         "per_stage_gb", "candidates": {"tp{t}_pp{p}": {...}}}
+
+    Raises ValueError when nothing fits — the caller must shard further or
+    shrink capacities, never silently over-subscribe HBM.
+    """
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    from ..serve.inference_manager import tensor_parallel_strategy
+    from ..serve.ops import IncMultiHeadSelfAttention
+    from ..serve.pp import build_stage_plans, serve_stage_split
+
+    graph = model.graph if hasattr(model, "graph") else model
+    devices = list(devices if devices is not None else jax.devices())
+    kv_heads = None
+    n_layers = 0
+    for node in graph.nodes:
+        if isinstance(node.op, IncMultiHeadSelfAttention):
+            kv_heads = node.op.num_kv_heads
+            n_layers += 1
+    if not n_layers:
+        raise ValueError("graph has no serve attention ops")
+
+    candidates: Dict[str, Dict] = {}
+    best = None
+    for tp in range(1, n_chips + 1):
+        if n_chips % tp or kv_heads % tp:
+            continue
+        pp = n_chips // tp
+        if pp > n_layers or tp > len(devices):
+            continue
+        # costing mesh: shardings are symbolic, so every stage prices over
+        # the same tp-wide device slice
+        mesh = make_mesh({"tp": tp}, devices[:tp])
+        mm = machine or MachineModel.for_mesh(mesh, spec_name=spec_name)
+        cap = hbm_cap if hbm_cap is not None else mm.spec.hbm_capacity
+        try:
+            split = serve_stage_split(graph, pp)
+        except ValueError as e:
+            candidates[f"tp{tp}_pp{pp}"] = {"error": str(e)[:80]}
+            continue
+        strategy = tensor_parallel_strategy(graph, ("tp",), mesh) \
+            if tp > 1 else {}
+        plans = build_stage_plans(graph, split, strategy, [mesh] * pp)
+        mem = [plan_memory_bytes(p, training=False) for p in plans]
+        entry = {
+            "tp": tp, "pp": pp,
+            "per_stage_gb": [round(b / 1e9, 3) for b in mem],
+            "fits": max(mem) <= cap,
+        }
+        bbytes = _boundary_bytes(graph, split)
+        by_m = {}
+        for m in sorted(set(int(x) for x in n_micro)):
+            if m < 1:
+                continue
+            cost = pp_serve_cost(plans, mm, n_micro=m,
+                                 boundary_bytes=bbytes)
+            by_m[str(m)] = {
+                "tpot_ms": round(cost["tpot_s"] * 1e3, 4),
+                "bubble_frac": round(cost["bubble_frac"], 4),
+                "transfer_ms": round(cost["transfer_s"] * 1e3, 5),
+            }
+            if entry["fits"] and (best is None
+                                  or cost["tpot_s"] < best["tpot_s"]):
+                best = {
+                    "tp": tp, "pp": pp, "n_micro": m,
+                    "tpot_s": cost["tpot_s"],
+                    "tpot_ms": round(cost["tpot_s"] * 1e3, 4),
+                    "bubble_frac": round(cost["bubble_frac"], 4),
+                    "transfer_ms": round(cost["transfer_s"] * 1e3, 5),
+                    "per_stage_gb": entry["per_stage_gb"],
+                }
+        entry["by_micro"] = by_m
+        candidates[f"tp{tp}_pp{pp}"] = entry
+
+    if best is None:
+        raise ValueError(
+            f"no tp x pp = {n_chips} plan fits the per-chip HBM cap; "
+            f"candidates: { {k: v.get('per_stage_gb') for k, v in candidates.items()} }"
+        )
+    best["candidates"] = candidates
+    return best

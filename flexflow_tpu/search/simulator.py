@@ -156,6 +156,37 @@ def _measure_key(step: Step, mesh):
     return (step.node.op.attr_signature(), local_in)
 
 
+def step_state_bytes(step: Step, mesh, names=None) -> float:
+    """Local bytes of one op's registered serve-state buffers (KV caches +
+    spec buffers), sharded by the step's own head-axis config.  ``names``
+    optionally restricts to specific buffers (the PP decode cost model
+    counts only the committed k/v (+scale) caches it streams per
+    macro-step).  0.0 for ops without registered serve capacities."""
+    op = step.node.op
+    if not (hasattr(op, "state_specs")
+            and getattr(op, "cost_max_requests", None)):
+        return 0.0
+    import jax.numpy as jnp  # np.dtype can't parse "bfloat16"
+
+    head_axes = tuple((step.config or {}).get("head", ()))
+    specs = op.state_specs(
+        op.cost_max_requests,
+        getattr(op, "cost_seq_len", 512),
+        getattr(op, "cost_max_spec", 0),
+        head_axes,
+    )
+    total = 0.0
+    for name, (shape, dt, sh) in specs.items():
+        if names is not None and name not in names:
+            continue
+        try:
+            local = sh.local_shape(shape, mesh)
+        except ValueError:
+            local = shape
+        total += int(np.prod(local)) * jnp.dtype(dt).itemsize
+    return total
+
+
 def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
     """Per-device peak-HBM estimate for a planned PCG.
 
@@ -214,25 +245,7 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
             acts.append(
                 _local_size(spec, sh, mesh) * (spec.nbytes() // max(spec.size, 1))
             )
-        op = step.node.op
-        if (hasattr(op, "state_specs")
-                and getattr(op, "cost_max_requests", None)):
-            head_axes = tuple((step.config or {}).get("head", ()))
-            specs = op.state_specs(
-                op.cost_max_requests,
-                getattr(op, "cost_seq_len", 512),
-                getattr(op, "cost_max_spec", 0),
-                head_axes,
-            )
-            import jax.numpy as jnp  # np.dtype can't parse "bfloat16"
-
-            for shape, dt, sh in specs.values():
-                itemsize = jnp.dtype(dt).itemsize
-                try:
-                    local = sh.local_shape(shape, mesh)
-                except ValueError:
-                    local = shape
-                state += int(np.prod(local)) * itemsize
+        state += step_state_bytes(step, mesh)
     act = sum(acts) if training else max(acts, default=0)
     return params + act + state
 

@@ -25,6 +25,11 @@ from .ops import (
     SpecIncMultiHeadSelfAttention,
     TreeIncMultiHeadSelfAttention,
 )
+from .pp import (
+    PipelinedInferenceManager,
+    build_stage_plans,
+    serve_stage_split,
+)
 from .request_manager import (
     GenerationConfig,
     Request,
@@ -45,6 +50,9 @@ __all__ = [
     "TreeVerifyBatchConfig",
     "InferenceResult",
     "InferenceManager",
+    "PipelinedInferenceManager",
+    "serve_stage_split",
+    "build_stage_plans",
     "tensor_parallel_strategy",
     "searched_serve_strategy",
     "RequestManager",

@@ -83,6 +83,35 @@ class BatchConfig:
             seq_lens=seq_lens,
         )
 
+    def split_microbatches(self, n_micro: int) -> list:
+        """Split the flat token batch into ``n_micro`` contiguous ranges —
+        the decode-time micro-batches pipeline-parallel serving interleaves
+        across stages (Orca-style).
+
+        Exact by construction: the builders lay a request's tokens out
+        contiguously in ascending position order, so a contiguous range
+        split preserves in-request ordering; a token's causal frontier only
+        ever reaches KV written by earlier flat slots (same micro-batch:
+        written before attending, as in the flat step) or by earlier
+        micro-batches (committed before that micro-batch runs).  Each
+        micro-batch keeps the full ``seq_lens`` (attention masks use
+        ``token_position`` only) and clips ``num_tokens`` to its range.
+        """
+        if n_micro <= 1 or self.max_tokens % n_micro:
+            return [self]
+        k = self.max_tokens // n_micro
+        out = []
+        for j in range(n_micro):
+            lo = j * k
+            out.append(BatchConfig(
+                tokens=self.tokens[lo: lo + k],
+                request_index=self.request_index[lo: lo + k],
+                token_position=self.token_position[lo: lo + k],
+                num_tokens=jnp.clip(self.num_tokens - lo, 0, k),
+                seq_lens=self.seq_lens,
+            ))
+        return out
+
     @staticmethod
     def build(
         token_ids,

@@ -133,6 +133,115 @@ def searched_serve_strategy(model, budget: int = 300, seed: int = 0,
     )
 
 
+def register_serve_capacities(graph, max_requests, max_seq_len,
+                              max_spec_tokens=0, kv_dtype=None):
+    """Record the serving capacities + KV dtype on a serve graph's attention
+    ops so planning (``plan_memory_bytes``), the serve search, and the cache
+    allocator all see the deployment's real buffer shapes.  Shared by the
+    single-plan :class:`InferenceManager` and the stage-split
+    :class:`~flexflow_tpu.serve.pp.PipelinedInferenceManager`."""
+    for node in graph.nodes:
+        if isinstance(node.op, IncMultiHeadSelfAttention):
+            node.op.cost_seq_len = max_seq_len
+            node.op.cost_max_requests = max_requests
+            node.op.cost_max_spec = max_spec_tokens
+            node.op.kv_dtype = kv_dtype
+
+
+def mark_gated_lm_head(graph, out_tids, max_requests) -> bool:
+    """Mark the logits-producing Linear for LM-head gating (single-output
+    graphs only).  Returns whether a Linear was actually marked — the guard
+    the ``gate_lm_head`` property ANDs in (see InferenceManager.__init__)."""
+    if len(out_tids) != 1:
+        return False
+    from ..ops.linear import Linear
+
+    marked = False
+    for node in graph.nodes:
+        if out_tids[0] in node.outputs and isinstance(node.op, Linear):
+            node.op.lm_head_gated = True
+            node.op.cost_logit_rows = max_requests
+            marked = True
+    return marked
+
+
+def allocate_attention_state(nodes, strategy, mesh, max_requests,
+                             max_seq_len, max_spec_tokens=0,
+                             always_place=False):
+    """Allocate the KV/spec cache buffers for the attention ops in
+    ``nodes`` — the single source of the cache layout shared by the
+    single-plan manager and the per-stage allocator of pipeline-parallel
+    serving (so the seq-pad rule and buffer name set cannot diverge from
+    the bit-identity contract the pp tests pin).
+
+    The k/v (+ int8 scale) seq dim is rounded up to a lane-width (128)
+    multiple so the Pallas kernels always get a dividing power-of-two
+    block; extra slots sit beyond every mask, and the int8 scale buffers
+    share the caches' seq dim so they pad identically.
+
+    ``always_place``: commit buffers to ``mesh`` even when it is a single
+    device — per-stage KV residency is the capacity contract of PP serving
+    (the default only places on multi-device meshes, matching the
+    single-plan manager's historical behavior).
+    """
+    state: Dict[str, Any] = {}
+    for node in nodes:
+        op = node.op
+        if not isinstance(op, IncMultiHeadSelfAttention):
+            continue
+        head_axes = tuple(strategy.get(node.name, {}).get("head", ()))
+        specs = op.state_specs(max_requests, max_seq_len, max_spec_tokens,
+                               head_axes)
+        bufs = {}
+        for name, (shape, dt, sh) in specs.items():
+            if name in ("k", "v", "k_scale", "v_scale"):
+                s_pad = -(-shape[2] // 128) * 128
+                shape = shape[:2] + (s_pad,) + shape[3:]
+            arr = jnp.zeros(shape, jnp.dtype(dt))
+            if always_place or (mesh is not None and mesh.size > 1):
+                arr = jax.device_put(arr, sh.named_sharding(mesh))
+            bufs[name] = arr
+        state[node.name] = bufs
+    return state
+
+
+def pick_prefill_tile(max_tokens_per_batch: int, max_seq_len: int) -> int:
+    """Query-tile width for the Pallas prefill kernel: the largest
+    power-of-two divisor of ``max_tokens_per_batch`` capped at 128 that also
+    divides ``max_seq_len`` (contract (d) of PrefillBatchConfig — tiled
+    segment starts must never clamp against the cache's seq capacity)."""
+    tile = 1
+    while tile < 128 and max_tokens_per_batch % (tile * 2) == 0:
+        tile *= 2
+    while tile > 1 and max_seq_len % tile:
+        tile //= 2
+    return tile
+
+
+def sample_tokens(logits, sample):
+    """Temperature + nucleus (top-p) sampling; exact argmax at T<=0.
+
+    Same math as the ``Sampling`` graph op (ops/reduction.py, reference
+    ``src/ops/sampling.cu``) but with DYNAMIC temperature/top_p (traced
+    scalars, so one compiled step serves every GenerationConfig) and an
+    explicit key threaded from the RequestManager.
+    """
+    key, temperature, top_p = sample
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def draw(_):
+        lg = logits / jnp.maximum(temperature, 1e-6)
+        sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_lg, cutoff_idx, axis=-1)
+        lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+    return jax.lax.cond(temperature <= 0.0, lambda _: greedy, draw, None)
+
+
 class InferenceManager:
     def __init__(
         self,
@@ -205,12 +314,8 @@ class InferenceManager:
         self.tp_axes = tuple(tp_axes)
         # register serve capacities on the attention ops so the search's
         # cost/memory models see the KV + spec buffers (plan_memory_bytes)
-        for node in model.graph.nodes:
-            if isinstance(node.op, IncMultiHeadSelfAttention):
-                node.op.cost_seq_len = max_seq_len
-                node.op.cost_max_requests = max_requests
-                node.op.cost_max_spec = max_spec_tokens
-                node.op.kv_dtype = kv_dtype
+        register_serve_capacities(model.graph, max_requests, max_seq_len,
+                                  max_spec_tokens, kv_dtype)
         if outputs is None:
             out_tids = [model.graph.nodes[-1].outputs[-1]]
         else:
@@ -229,14 +334,9 @@ class InferenceManager:
         # points against flat-indexed results would corrupt every request.
         self._lm_head_marked = False
         self._gate_lm_head = bool(gate_lm_head)
-        if gate_lm_head and len(out_tids) == 1:
-            from ..ops.linear import Linear
-
-            for node in model.graph.nodes:
-                if out_tids[0] in node.outputs and isinstance(node.op, Linear):
-                    node.op.lm_head_gated = True
-                    node.op.cost_logit_rows = max_requests
-                    self._lm_head_marked = True
+        if gate_lm_head:
+            self._lm_head_marked = mark_gated_lm_head(
+                model.graph, out_tids, max_requests)
         if strategy == "search":
             strategy = searched_serve_strategy(model)
         elif strategy is None:
@@ -272,21 +372,11 @@ class InferenceManager:
         # block_s]) until it fits, so the wider tile is admissible: half
         # the grid rows per chunk, half the per-row DMA-wait boundaries.
         # RequestManager builds PrefillBatchConfigs with this tile size for
-        # pure-prefill steps.
-        tile = 1
-        while (tile < 128 and max_tokens_per_batch % (tile * 2) == 0):
-            tile *= 2
-        # the tile must also divide max_seq_len (ADVICE r5 medium): the
-        # tiled-prefill block DUS assumes tile-aligned starts never clamp
-        # against the cache's seq capacity.  The allocated cache is padded
-        # to a 128 multiple (every power-of-two tile <= 128 divides that),
-        # but enforcing divisibility against the DECLARED max_seq_len keeps
-        # the contract independent of the padding detail — and keeps
-        # prompt-end tiles from straddling the declared capacity.  Shrink
-        # rather than raise: halving stays within the builder contract.
-        while tile > 1 and max_seq_len % tile:
-            tile //= 2
-        self.prefill_tile = tile
+        # pure-prefill steps.  The tile must also divide max_seq_len
+        # (ADVICE r5 medium): the tiled-prefill block DUS assumes
+        # tile-aligned starts never clamp against the cache's seq capacity.
+        self.prefill_tile = pick_prefill_tile(max_tokens_per_batch,
+                                              max_seq_len)
         # fixed tree-token layout (rows, slots) registered by SpecDecodeScan
         # (one per InferenceManager); the layout is PASSED per step by the
         # scan, never applied to host-built tree batches
@@ -356,62 +446,16 @@ class InferenceManager:
         return self
 
     def allocate_kv_cache(self):
-        mesh = self.plan.mesh
-        state: Dict[str, Any] = {}
-        for node in self.model.graph.nodes:
-            op = node.op
-            if not isinstance(op, IncMultiHeadSelfAttention):
-                continue
-            head_axes = tuple(
-                self.strategy.get(node.name, {}).get("head", ())
-            )
-            specs = op.state_specs(
-                self.max_requests,
-                self.max_seq_len,
-                self.max_spec_tokens,
-                head_axes,
-            )
-            bufs = {}
-            for name, (shape, dt, sh) in specs.items():
-                if name in ("k", "v", "k_scale", "v_scale"):
-                    # round the seq dim up to a lane-width multiple so the
-                    # Pallas kernels always get a dividing power-of-two
-                    # block (gcd fallback would otherwise collapse to tiny
-                    # blocks for odd max_seq_len); extra slots sit beyond
-                    # every mask.  The int8 scale buffers share the caches'
-                    # seq dim (dim 2), so they pad identically.
-                    s_pad = -(-shape[2] // 128) * 128
-                    shape = shape[:2] + (s_pad,) + shape[3:]
-                arr = jnp.zeros(shape, jnp.dtype(dt))
-                if mesh is not None and mesh.size > 1:
-                    arr = jax.device_put(arr, sh.named_sharding(mesh))
-                bufs[name] = arr
-            state[node.name] = bufs
-        return state
+        return allocate_attention_state(
+            self.model.graph.nodes, self.strategy, self.plan.mesh,
+            self.max_requests, self.max_seq_len, self.max_spec_tokens,
+        )
 
     # ------------------------------------------------------------------
     def _sample_tokens(self, logits, sample):
-        """Temperature + nucleus (top-p) sampling; exact argmax at T<=0.
-
-        Same math as the ``Sampling`` graph op (ops/reduction.py, reference
-        ``src/ops/sampling.cu``) but with DYNAMIC temperature/top_p (traced
-        scalars, so one compiled step serves every GenerationConfig) and an
-        explicit key threaded from the RequestManager.
-        """
-        key, temperature, top_p = sample
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-        def draw(_):
-            lg = logits / jnp.maximum(temperature, 1e-6)
-            sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_lg, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-            cutoff = jnp.take_along_axis(sorted_lg, cutoff_idx, axis=-1)
-            lg = jnp.where(lg < cutoff, -jnp.inf, lg)
-            return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
-
-        return jax.lax.cond(temperature <= 0.0, lambda _: greedy, draw, None)
+        """See module-level :func:`sample_tokens` (shared with the
+        pipeline-parallel manager)."""
+        return sample_tokens(logits, sample)
 
     def _step_impl(self, params, state, bc, sample=None, tree_layout=None,
                    qkv0=None):

@@ -1,0 +1,578 @@
+"""Pipeline-parallel serving: stage-split decode with micro-batch interleaving.
+
+SURVEY §4's inference matrix is "model x precision x TP/PP configs"; the serve
+stack so far covered TP only.  This module adds the PP column: the serve graph
+is split into ``pp`` contiguous STAGES at small live-set boundaries (the same
+live-cut machinery the GPipe training executor carves SESE segments with —
+``core.graph.live_cuts``), each stage compiles to its own program over its own
+device slice (weights + that stage's KV caches resident per slice — the
+capacity lever that lets shapes exceeding one chip's HBM serve across the pp
+axis), and activations hop stage to stage.  Decode-time micro-batch
+interleaving (Orca OSDI'22) keeps every stage busy: ``m`` micro-batches cycle
+through the stage chain continuously, shrinking the steady-state pipeline
+bubble from ``(pp-1)/pp`` (one batch, ``m=1``) to ``(pp-m)/pp`` — zero once
+``m >= pp`` fills the pipeline (``m = pp`` is the decode optimum: beyond it
+stage weights re-stream per micro-batch for no bubble win).
+
+Execution model — MULTI-PROGRAM, host-interleaved: one jitted step per stage
+per batch-config type, dispatched asynchronously.  Stage programs occupy
+disjoint devices, so dispatching micro-batch j+1's stage-0 right after
+micro-batch j's (whose stage-1 is still running) overlaps them for real; the
+host never blocks inside a macro-step (the one sync is the caller reading
+results).  Inter-stage transfer is a ``jax.device_put`` of the boundary
+activations onto the next stage's mesh — on TPU this lowers to an ICI
+device-to-device copy, the point-to-point analogue of the training pipeline's
+``ppermute`` (which needs every stage inside ONE program; serve stages are
+deliberately separate programs so each keeps its own donated KV state and its
+own TP sharding through the existing GSPMD path).
+
+Bit-identity: each micro-batch runs the exact op ``lower``s of the plan steps
+the single-stage InferenceManager would run, in the same order, on the same
+values — stage boundaries only name where activations change devices, and
+contiguous-range micro-batch splits preserve the flat batch's causal layout
+(see ``BatchConfig.split_microbatches``).  Pinned by tests/test_pp_serve.py
+for decode, prefill (tiled + gated), and mixed steps, incl. the int8-weights +
+int8-KV configuration.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import live_cuts
+from ..core.interpreter import build_forward, init_params
+from ..core.pcg import PCG
+from .batch_config import BatchConfig, InferenceResult
+from .inference_manager import (
+    allocate_attention_state,
+    mark_gated_lm_head,
+    pick_prefill_tile,
+    register_serve_capacities,
+    sample_tokens,
+    tensor_parallel_strategy,
+)
+from .ops import IncMultiHeadSelfAttention
+
+
+def serve_stage_split(graph, pp: int, out_tid: Optional[int] = None,
+                      max_live: int = 2):
+    """Split a serve graph's node chain into ``pp`` contiguous stages.
+
+    Cuts are placed at boundaries whose live tensor set is at most
+    ``max_live`` wide (llama-family graphs carry ``{residual, hidden}``
+    between decoder layers, so 2 covers them; a pure op chain cuts at
+    SESE single-tensor boundaries), balanced so each stage owns an equal
+    share of the attention layers — the weight- and KV-heavy units.  Ties
+    prefer the narrowest cut, then the latest boundary (so norms feeding a
+    layer stay with the upstream stage and the next stage starts at its
+    attention).
+
+    Returns ``[(nodes, entry_tids, exit_tids)]`` with
+    ``exit_tids[s] == entry_tids[s+1]`` (sorted tid order),
+    ``entry_tids[0] == graph.input_tids`` and ``exit_tids[-1] == [out_tid]``.
+    """
+    nodes = graph.nodes
+    if not nodes:
+        raise ValueError("empty graph")
+    if out_tid is None:
+        out_tid = nodes[-1].outputs[-1]
+    if pp <= 1:
+        return [(list(nodes), list(graph.input_tids), [out_tid])]
+    lives = live_cuts(graph, [out_tid])
+    is_attn = [isinstance(n.op, IncMultiHeadSelfAttention) for n in nodes]
+    total = sum(is_attn)
+    if pp > total:
+        raise ValueError(
+            f"pp={pp} stages need at least that many attention layers "
+            f"(graph has {total})"
+        )
+    cum = np.cumsum(is_attn)
+    candidates = [i for i in range(len(nodes) - 1)
+                  if len(lives[i]) <= max_live]
+    cuts: List[int] = []
+    lo_attn = 0
+    for s in range(1, pp):
+        target = total * s / pp
+        pool = [i for i in candidates
+                if lo_attn < cum[i] < total
+                and (not cuts or i > cuts[-1])]
+        if not pool:
+            raise ValueError(
+                f"no admissible cut for stage boundary {s} "
+                f"(live sets wider than {max_live}?)"
+            )
+        best = min(pool, key=lambda i: (abs(cum[i] - target),
+                                        len(lives[i]), -i))
+        cuts.append(best)
+        lo_attn = cum[best]
+    bounds = [-1] + cuts + [len(nodes) - 1]
+    stages = []
+    for s in range(pp):
+        seg = nodes[bounds[s] + 1: bounds[s + 1] + 1]
+        entry = (list(graph.input_tids) if s == 0
+                 else sorted(lives[bounds[s]]))
+        exit_ = ([out_tid] if s == pp - 1 else sorted(lives[bounds[s + 1]]))
+        stages.append((seg, entry, exit_))
+    return stages
+
+
+class _StageView:
+    """Graph-protocol view of a contiguous node range, plannable by PCG.
+
+    Tensor ids (and ``tensor_specs``) are shared with the parent graph, so
+    stage entry tids are exactly the parent's boundary tensors; the view
+    only narrows ``nodes`` and redeclares the boundary as graph inputs.
+    """
+
+    def __init__(self, parent, nodes, input_tids):
+        self.nodes = list(nodes)
+        self.input_tids = list(input_tids)
+        self.tensor_specs = parent.tensor_specs
+        self._parent = parent
+
+    def topo_order(self):
+        return self.nodes
+
+    def spec(self, tid):
+        return self.tensor_specs[tid]
+
+    def unique_name(self, base):
+        return self._parent.unique_name(base)
+
+
+def build_stage_plans(graph, split, strategy, meshes):
+    """One PCG plan per stage: the stage's nodes over its own mesh, with the
+    (TP) strategy restricted to them and the boundary tensors as plan
+    inputs/outputs.  Used by the executor below AND by the serve search's
+    TP x PP pricing (``search.serve_search``) — per-stage
+    ``plan_memory_bytes`` is what gates pp admissibility under the HBM cap.
+    """
+    plans = []
+    for (nodes, entry, exit_), mesh in zip(split, meshes):
+        names = {n.name for n in nodes}
+        cfg = {k: v for k, v in (strategy or {}).items() if k in names}
+        view = _StageView(graph, nodes, entry)
+        plans.append(PCG(view, mesh, cfg, output_tids=list(exit_)).plan())
+    return plans
+
+
+class _Stage:
+    """One pipeline stage: plan + params + KV state + jitted step."""
+
+    def __init__(self, nodes, entry_tids, exit_tids, mesh, plan):
+        self.nodes = nodes
+        self.entry_tids = list(entry_tids)
+        self.exit_tids = list(exit_tids)
+        self.mesh = mesh
+        self.plan = plan
+        self.fwd = build_forward(plan, mode="spmd")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.replicated = NamedSharding(mesh, P())
+        self.params: Optional[Dict] = None
+        self.state: Optional[Dict] = None
+        self.step = None  # bound by the manager (closes over its flags)
+
+
+class PipelinedInferenceManager:
+    """Stage-split serving over a ``pp`` (x ``tp``) mesh.
+
+    ``model.mesh`` must carry a ``pp`` axis (and optionally ``tp``); each of
+    the ``pp`` device slices runs one stage, tensor-parallel over its own
+    ``tp`` sub-axis through the unchanged GSPMD serve path (Megatron head
+    sharding, Pallas kernels via the per-op shard_map).  API-compatible with
+    :class:`InferenceManager` for the RequestManager: ``step`` /
+    ``decode_scan`` / ``reset`` / capacity attributes all behave the same,
+    so continuous batching, chunked prefill (tiled + LM-head-gated) and the
+    serving loops run unmodified.
+
+    ``n_micro``: decode-time micro-batches per macro-step (default = pp).
+    Flat BatchConfigs split into ``n_micro`` contiguous token ranges that
+    pipeline through the stages; prefill chunks ride whole (successive
+    chunks already interleave across stages via async dispatch).
+
+    Not yet supported here: speculative decoding (``max_spec_tokens``) and
+    the on-device prefill scan — both need the single-program pipelining
+    this multi-program design trades away; chunked prefill covers the
+    prompt phase instead.
+    """
+
+    def __init__(
+        self,
+        model,
+        max_requests: int = 8,
+        max_tokens_per_batch: int = 64,
+        max_seq_len: int = 512,
+        n_micro: Optional[int] = None,
+        strategy: Optional[Dict[str, Dict]] = None,
+        outputs=None,
+        use_pallas: str = "auto",
+        kv_dtype: Optional[str] = None,
+        gate_lm_head: bool = True,
+        topk: int = 0,
+    ):
+        from ..parallel.mesh import make_mesh
+
+        self.model = model
+        self.max_requests = max_requests
+        self.max_tokens = max_tokens_per_batch
+        self.max_seq_len = max_seq_len
+        self.max_spec_tokens = 0
+        self.topk = topk
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                             "(expected None or 'int8')")
+        self.kv_dtype = kv_dtype
+        mesh = model.mesh
+        if mesh is None or "pp" not in mesh.shape:
+            raise ValueError("PipelinedInferenceManager needs a mesh with a "
+                             "'pp' axis (use InferenceManager for pure TP)")
+        shape = dict(mesh.shape)
+        pp = shape["pp"]
+        tp = shape.get("tp", 1)
+        for a, n in shape.items():
+            if a not in ("pp", "tp") and n > 1:
+                raise ValueError(f"unsupported serve mesh axis {a!r}")
+        self.pp = pp
+        self.tp = tp
+        self.n_micro = int(n_micro) if n_micro else pp
+        if self.max_tokens % self.n_micro:
+            # micro-batches are contiguous EQUAL token ranges of the
+            # compiled capacity, so the count must divide it; fall back to
+            # the largest divisor and say so rather than silently running
+            # the bubble-dominated schedule the caller asked to avoid
+            import warnings
+
+            fixed = max(d for d in range(1, self.n_micro + 1)
+                        if self.max_tokens % d == 0)
+            warnings.warn(
+                f"n_micro={self.n_micro} does not divide "
+                f"max_tokens_per_batch={self.max_tokens}; using "
+                f"n_micro={fixed}", stacklevel=2)
+            self.n_micro = fixed
+
+        register_serve_capacities(model.graph, max_requests, max_seq_len,
+                                  0, kv_dtype)
+        if outputs is None:
+            out_tids = [model.graph.nodes[-1].outputs[-1]]
+        else:
+            outputs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            out_tids = [t.tid for t in outputs]
+        self._gate_lm_head = bool(gate_lm_head)
+        self._lm_head_marked = (mark_gated_lm_head(
+            model.graph, out_tids, max_requests) if gate_lm_head else False)
+
+        # ---- stage meshes: pp-major device slices, tp within a slice ----
+        names = list(mesh.axis_names)
+        arr = np.asarray(mesh.devices)
+        perm = [names.index("pp")] + [i for i, n in enumerate(names)
+                                     if n != "pp"]
+        arr = arr.transpose(perm).reshape(pp, -1)
+        self.stage_meshes = [make_mesh({"tp": tp}, list(arr[s]))
+                             for s in range(pp)]
+        if strategy is None:
+            strategy = tensor_parallel_strategy(
+                model.graph, ("tp",), self.stage_meshes[0]) if tp > 1 else {}
+        self.strategy = strategy
+
+        split = serve_stage_split(model.graph, pp, out_tids[0])
+        plans = build_stage_plans(model.graph, split, strategy,
+                                  self.stage_meshes)
+        self.stages = [
+            _Stage(nodes, entry, exit_, m, plan)
+            for (nodes, entry, exit_), m, plan
+            in zip(split, self.stage_meshes, plans)
+        ]
+        self.stage_plans = plans
+        self._token_tid = model.graph.input_tids[0]
+
+        backend = jax.default_backend()
+        self.use_pallas = (backend == "tpu") if use_pallas == "auto" \
+            else bool(use_pallas)
+        self.pallas_interpret = backend != "tpu"
+        self.prefill_tile = pick_prefill_tile(max_tokens_per_batch,
+                                              max_seq_len)
+        self.tree_token_layout = None
+        self.prefill_overlap = False  # single-program lever; N/A here
+
+        from ..utils.platform import collective_safe_compiler_options
+
+        n_stages = len(self.stages)
+        for s, stage in enumerate(self.stages):
+            stage.step = jax.jit(
+                self._make_stage_impl(stage, last=(s == n_stages - 1)),
+                donate_argnums=(1,),
+                compiler_options=collective_safe_compiler_options(stage.mesh),
+            )
+        last_mesh = self.stages[-1].mesh
+        self._advance = jax.jit(
+            self._advance_impl, static_argnames=("eos",),
+            compiler_options=collective_safe_compiler_options(last_mesh),
+        )
+
+    # ------------------------------------------------------------------
+    @property
+    def gate_lm_head(self) -> bool:
+        return self._gate_lm_head and self._lm_head_marked
+
+    @gate_lm_head.setter
+    def gate_lm_head(self, value) -> None:
+        self._gate_lm_head = bool(value)
+
+    @property
+    def params(self):
+        """Merged per-node param dict across stages (shared sub-dicts, so
+        in-place updates — e.g. ``quantize_int8`` — reach the stages)."""
+        if self.stages[0].params is None:
+            return None
+        merged: Dict[str, Dict] = {}
+        for stage in self.stages:
+            merged.update(stage.params)
+        return merged
+
+    @property
+    def state(self):
+        """Merged per-node KV state across stages (read-only convenience for
+        tests/diagnostics; the live buffers are per stage)."""
+        if self.stages[0].state is None:
+            return None
+        merged: Dict[str, Dict] = {}
+        for stage in self.stages:
+            merged.update(stage.state)
+        return merged
+
+    # ------------------------------------------------------------------
+    def _make_stage_impl(self, stage, last: bool):
+        fwd = stage.fwd
+        entry = tuple(stage.entry_tids)
+        token_tid = self._token_tid
+
+        def impl(params, state, bc, xs, sample=None):
+            base = bc if isinstance(bc, BatchConfig) else bc.base
+            if entry == (token_tid,):
+                inputs = {token_tid: base.tokens}
+            else:
+                inputs = dict(zip(entry, xs))
+            outs, new_state = fwd(
+                params, inputs, state=state,
+                extras={
+                    "batch_config": bc,
+                    "pallas_decode": self.use_pallas,
+                    "pallas_interpret": self.pallas_interpret,
+                    "tree_layout": None,
+                    "qkv0": None,
+                },
+            )
+            if not last:
+                return tuple(outs), new_state
+            logits = outs[0].astype(jnp.float32)
+            if sample is not None:
+                token_ids = sample_tokens(logits, sample)
+            else:
+                token_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits_max = jnp.max(logits, axis=-1)
+            topk_ids = topk_lp = None
+            if self.topk:
+                lp = jax.nn.log_softmax(logits, axis=-1)
+                topk_lp, topk_ids = jax.lax.top_k(lp, self.topk)
+                topk_ids = topk_ids.astype(jnp.int32)
+            return (
+                InferenceResult(token_ids, logits_max, topk_ids, topk_lp),
+                new_state,
+            )
+
+        return impl
+
+    # ------------------------------------------------------------------
+    def init_operators_inference(self, params=None, rng=None, dtype=None):
+        graph = self.model.graph
+        if params is None:
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+            for stage in self.stages:
+                only = {n.name for n in stage.nodes}
+                # same global key indices as the single-plan init: weights
+                # are bit-identical to the non-pp manager with this seed
+                stage.params = init_params(graph, stage.plan, rng,
+                                           dtype=dtype, only=only)
+        else:
+            for stage in self.stages:
+                sub = {}
+                for node in stage.nodes:
+                    g = params.get(node.name)
+                    if g is None:
+                        continue
+                    shs = stage.plan.param_shardings.get(node.name, {})
+                    placed = {}
+                    for pname, arr in g.items():
+                        sh = shs.get(pname)
+                        tgt = (sh.named_sharding(stage.mesh) if sh is not None
+                               else stage.replicated)
+                        placed[pname] = jax.device_put(arr, tgt)
+                    sub[node.name] = placed
+                stage.params = sub
+        self.allocate_kv_cache()
+        return self
+
+    def allocate_kv_cache(self):
+        for stage in self.stages:
+            # always_place: committed to the stage's mesh even when it is
+            # one device — per-stage KV residency is the capacity contract
+            stage.state = allocate_attention_state(
+                stage.nodes, self.strategy, stage.mesh,
+                self.max_requests, self.max_seq_len, 0, always_place=True,
+            )
+        return self.state
+
+    def reset(self):
+        self.allocate_kv_cache()
+
+    # ------------------------------------------------------------------
+    def _microbatches(self, bc):
+        if isinstance(bc, BatchConfig):
+            return bc.split_microbatches(self.n_micro)
+        return [bc]  # prefill chunks / tree batches ride whole
+
+    def _dispatch(self, bc, sample=None):
+        """One micro-batch through the stage chain; returns the last
+        stage's InferenceResult (device arrays, not synced)."""
+        xs: Tuple = ()
+        res = None
+        n = len(self.stages)
+        for s, stage in enumerate(self.stages):
+            bc_s = jax.device_put(bc, stage.replicated)
+            if s > 0:
+                xs = tuple(jax.device_put(x, stage.replicated) for x in xs)
+            if s < n - 1:
+                xs, stage.state = stage.step(stage.params, stage.state,
+                                             bc_s, xs)
+            else:
+                smp = (jax.device_put(sample, stage.replicated)
+                       if sample is not None else None)
+                res, stage.state = stage.step(stage.params, stage.state,
+                                              bc_s, xs, smp)
+        return res
+
+    @staticmethod
+    def _merge_results(results: Sequence[InferenceResult]) -> InferenceResult:
+        if len(results) == 1:
+            return results[0]
+        cat = lambda xs: (None if xs[0] is None
+                          else jnp.concatenate(list(xs), axis=0))
+        return InferenceResult(
+            cat([r.token_ids for r in results]),
+            cat([r.logits_max for r in results]),
+            cat([r.topk_ids for r in results]),
+            cat([r.topk_logprobs for r in results]),
+        )
+
+    def step(self, bc, sample=None) -> InferenceResult:
+        """Run one serving macro-step: ``n_micro`` interleaved micro-batches
+        through the stage chain (async dispatch; stage s runs micro-batch j
+        while stage s-1 runs j+1).  Caches update in place per stage."""
+        assert self.stages[0].params is not None, \
+            "call init_operators_inference() first"
+        mbs = self._microbatches(bc)
+        results = []
+        for j, mb in enumerate(mbs):
+            smp = sample
+            if sample is not None and len(mbs) > 1:
+                # per-micro-batch key: same sampling distribution as the
+                # single-program step, different bitstream (documented)
+                key, t, p = sample
+                smp = (jax.random.fold_in(key, j), t, p)
+            results.append(self._dispatch(mb, smp))
+        return self._merge_results(results)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _advance_impl(bc, toks, alive, eos):
+        """The decode-scan body's advance/EOS logic (see
+        InferenceManager._decode_scan_impl), jitted on the last stage's
+        mesh so multi-step decode never syncs the host."""
+        live = alive
+        if eos is not None:
+            alive = alive & (toks != eos)
+        nxt = bc.advance(toks)
+        if eos is not None:
+            nxt = BatchConfig(
+                tokens=nxt.tokens,
+                request_index=jnp.where(alive, nxt.request_index, -1),
+                token_position=nxt.token_position,
+                num_tokens=nxt.num_tokens,
+                seq_lens=nxt.seq_lens,
+            )
+        return nxt, alive, live
+
+    def decode_scan(self, bc, n_steps: int, eos: Optional[int] = None,
+                    sample=None):
+        """``n_steps`` pure-decode macro-steps, host-dispatched but never
+        host-synced: each micro-batch's next BatchConfig derives on device
+        (``_advance_impl``) and flows back to stage 0, so the host only
+        reads tokens once at the end.  Micro-batches interleave across
+        stages step by step (i-major dispatch order).
+        """
+        assert self.stages[0].params is not None, \
+            "call init_operators_inference() first"
+        last = int(np.max(np.asarray(bc.token_position))) + n_steps
+        if last > self.max_seq_len:
+            raise ValueError(
+                f"decode_scan would reach position {last} > max_seq_len "
+                f"{self.max_seq_len}")
+        mbs = self._microbatches(bc)
+        m = len(mbs)
+        rep = self.stages[-1].replicated
+        mbs = [jax.device_put(mb, rep) for mb in mbs]
+        alive = [mb.request_index >= 0 for mb in mbs]
+        toks = [[None] * m for _ in range(n_steps)]
+        lives = [[None] * m for _ in range(n_steps)]
+        for i in range(n_steps):
+            for j in range(m):
+                smp = None
+                if sample is not None:
+                    key, t, p = sample
+                    smp = (jax.random.fold_in(key, i * m + j), t, p)
+                res = self._dispatch(mbs[j], smp)
+                mbs[j], alive[j], live = self._advance(
+                    mbs[j], res.token_ids, alive[j], eos=eos)
+                toks[i][j] = res.token_ids
+                lives[i][j] = live
+        tokens = np.stack([
+            np.concatenate([np.asarray(t) for t in row]) for row in toks
+        ])
+        live_np = np.stack([
+            np.concatenate([np.asarray(v) for v in row]) for row in lives
+        ])
+        bc_out = self._merge_bcs(mbs)
+        return tokens, live_np, bc_out
+
+    @staticmethod
+    def _merge_bcs(mbs: Sequence[BatchConfig]) -> BatchConfig:
+        if len(mbs) == 1:
+            return mbs[0]
+        seq = mbs[0].seq_lens
+        for mb in mbs[1:]:
+            # each micro-batch advanced only its own slots' depths
+            seq = jnp.maximum(seq, mb.seq_lens)
+        return BatchConfig(
+            tokens=jnp.concatenate([mb.tokens for mb in mbs]),
+            request_index=jnp.concatenate([mb.request_index for mb in mbs]),
+            token_position=jnp.concatenate(
+                [mb.token_position for mb in mbs]),
+            num_tokens=sum(mb.num_tokens for mb in mbs),
+            seq_lens=seq,
+        )
+
+    # ------------------------------------------------------------------
+    def stage_memory_bytes(self, training: bool = False) -> List[float]:
+        """Per-stage ``plan_memory_bytes`` — the capacity arithmetic the
+        serve search gates pp admissibility with (weights + KV + largest
+        transient, per device of each stage)."""
+        from ..search.simulator import plan_memory_bytes
+
+        return [plan_memory_bytes(p, training=training)
+                for p in self.stage_plans]

@@ -52,11 +52,16 @@ def _like_sharded(arr, ref):
     return jnp.asarray(arr)
 
 
-def _scale_sharding(kernel_ref, mesh):
+def _scale_sharding(kernel_ref, mesh=None):
     """NamedSharding for a per-out-channel scale: the kernel sharding's
-    spec with the contracted (first) dim dropped."""
+    spec with the contracted (first) dim dropped.  The mesh comes from the
+    kernel's OWN sharding — under pipeline-parallel serving each stage's
+    kernels live on that stage's sub-mesh, not the model's full mesh."""
     sh = getattr(kernel_ref, "sharding", None)
-    if sh is None or getattr(sh, "spec", None) is None or mesh is None:
+    if sh is None or getattr(sh, "spec", None) is None:
+        return None
+    mesh = getattr(sh, "mesh", None) or mesh
+    if mesh is None:
         return None
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -468,6 +468,83 @@ class RequestManager:
         self.steps += n
         self.scan_runs += 1
 
+    def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8):
+        """Arrival-driven serving: requests join the running admit/retire
+        loop at their offered times (open-loop load, the serving_under_load
+        bench's engine).
+
+        ``arrivals``: iterable of ``(t_offset_s, prompt_tokens,
+        max_new_tokens_or_None)`` — offsets from loop start; admitted once
+        the clock passes them.  ``clock``: 0-arg seconds callable
+        (injectable for hermetic tests; default ``time.perf_counter``).
+        ``quantum``: cap on the on-device decode-scan stretch while
+        arrivals are outstanding, so a long scan can't defer admission
+        unboundedly (TTFT protection; the full ``scan_chunk`` window
+        returns once every arrival is in).
+
+        Returns ``{rid: record}`` with ``arrival_s``, ``first_token_s``
+        (host-visible TTFT stamp), ``finish_s``, ``prompt_len`` and
+        ``tokens`` — per-request outputs are INVARIANT to arrival timing
+        (continuous batching only reorders work, never results), pinned by
+        tests/test_serving_under_load.py.
+        """
+        import time as _time
+
+        clock = clock or _time.perf_counter
+        t0 = clock()
+        pending = sorted(arrivals, key=lambda a: a[0])
+        records: Dict[int, Dict] = {}
+        saved_chunk = self.scan_chunk
+
+        def admit_due():
+            now = clock() - t0
+            while pending and pending[0][0] <= now:
+                off, prompt, mnt = pending.pop(0)
+                rid = self.register_new_request(prompt, mnt)
+                records[rid] = {"arrival_s": off, "admitted_s": now,
+                                "prompt_len": len(prompt)}
+            return clock() - t0
+
+        def stamp(now):
+            for rid, rec in records.items():
+                req = self.requests[rid]
+                if "first_token_s" not in rec and req.generated:
+                    rec["first_token_s"] = now
+                if ("finish_s" not in rec
+                        and req.status is RequestStatus.COMPLETED):
+                    rec["finish_s"] = now
+
+        try:
+            while pending or self.has_work():
+                now = admit_due()
+                if not self.has_work():
+                    # idle until the next arrival: a short bounded sleep for
+                    # ANY clock — real clocks stop busy-spinning, virtual
+                    # clocks (which advance per call) lose at most ~1ms of
+                    # wall time per idle poll
+                    if pending:
+                        _time.sleep(min(1e-3, max(0.0,
+                                                  pending[0][0] - now)))
+                    continue
+                self.scan_chunk = quantum if pending else saved_chunk
+                if self._prefill_stretch_possible():
+                    self._prefill_stretch()
+                else:
+                    n = self._scan_steps_possible()
+                    if n > 1:
+                        self._decode_stretch(n)
+                    else:
+                        bc, sample_points = self.prepare_next_batch()
+                        result = self.im.step(bc, sample=self._sample_arg())
+                        self.process_result(result, sample_points)
+                        self.steps += 1
+                stamp(clock() - t0)
+        finally:
+            self.scan_chunk = saved_chunk
+        for rid, rec in records.items():
+            rec["tokens"] = self.requests[rid].generated
+        return records
+
     def serve_incr_decoding(self) -> Dict[int, List[int]]:
         """Run the incremental-decoding loop until all requests complete.
 

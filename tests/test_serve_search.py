@@ -129,6 +129,86 @@ def test_searched_serve_warns_when_nothing_fits():
         searched_serve_strategy(ff, budget=60, seed=0, memory_limit=1024.0)
 
 
+def _cpu_machine():
+    from flexflow_tpu.search.machine_model import MachineModel
+
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    return MachineModel.for_mesh(mesh, spec_name="cpu")
+
+
+def test_pp_serve_cost_prices_transfer_and_bubbles():
+    """ISSUE 3 gate: the TP x PP decode cost model must charge the
+    inter-stage activation hop and the pipeline bubble, and micro-batch
+    interleaving must shrink the bubble (steady-state: m = pp fills the
+    pipeline)."""
+    from flexflow_tpu.search.serve_search import (
+        _boundary_bytes,
+        pp_serve_cost,
+    )
+    from flexflow_tpu.serve.pp import build_stage_plans, serve_stage_split
+
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff, _ = build_serve_model(mesh, max_seq=256, max_requests=8)
+    mm = _cpu_machine()
+    split = serve_stage_split(ff.graph, 2)
+    plans = build_stage_plans(ff.graph, split, {}, [mesh] * 2)
+    b = _boundary_bytes(ff.graph, split)
+    assert b > 0
+    c1 = pp_serve_cost(plans, mm, n_micro=1, boundary_bytes=b)
+    c2 = pp_serve_cost(plans, mm, n_micro=2, boundary_bytes=b)
+    assert c1["transfer_s"] > 0 and c2["transfer_s"] > 0
+    assert c1["bubble_frac"] == 0.5 and c2["bubble_frac"] == 0.0
+    # an interleaved full pipeline beats the bubble-dominated m=1 schedule
+    assert c2["tpot_s"] < c1["tpot_s"]
+    # the hop is priced per micro-batch: halving the payload can't RAISE it
+    assert c2["transfer_s"] <= c1["transfer_s"]
+
+
+def test_search_serve_plan_picks_pp_under_hbm_cap():
+    """ISSUE 3 acceptance: an MQA graph (kv_heads=1 — head-sharded TP is
+    inadmissible) that exceeds a per-chip cap must come back as a pp>=2
+    plan whose PER-STAGE memory fits, with bubbles+transfer priced."""
+    import dataclasses
+
+    from flexflow_tpu.search.serve_search import search_serve_plan
+    from flexflow_tpu.serve.pp import build_stage_plans, serve_stage_split
+
+    mqa = dataclasses.replace(TINY, num_key_value_heads=1)
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff = FFModel(FFConfig(), mesh=mesh)
+    build_model(ff, mqa, max_tokens=16)
+    from flexflow_tpu.serve.inference_manager import register_serve_capacities
+
+    register_serve_capacities(ff.graph, max_requests=8, max_seq_len=2048)
+    whole = plan_memory_bytes(PCG(ff.graph, mesh, {}).plan(), training=False)
+    split = serve_stage_split(ff.graph, 2)
+    stage = max(plan_memory_bytes(p, training=False) for p in
+                build_stage_plans(ff.graph, split, {}, [mesh] * 2))
+    assert stage < whole
+    cap = (stage + whole) / 2  # one chip can't hold it; one stage can
+    best = search_serve_plan(ff, n_chips=2, machine=_cpu_machine(),
+                             hbm_cap=cap, n_micro=(1, 2, 4))
+    assert best["pp"] == 2 and best["tp"] == 1
+    assert max(best["per_stage_gb"]) * 1e9 <= cap
+    assert best["n_micro"] >= 2, "interleaving should beat the m=1 bubble"
+    assert best["transfer_ms"] > 0
+    assert best["candidates"]["tp1_pp2"]["fits"]
+    # tp2 was never admissible: kv_heads=1 is not shardable
+    assert "tp2_pp1" not in best["candidates"]
+
+
+def test_search_serve_plan_raises_when_nothing_fits():
+    import pytest
+
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff, _ = build_serve_model(mesh, max_seq=2048, max_requests=8)
+    from flexflow_tpu.search.serve_search import search_serve_plan
+
+    with pytest.raises(ValueError, match="fits"):
+        search_serve_plan(ff, n_chips=2, machine=_cpu_machine(),
+                          hbm_cap=1024.0)
+
+
 def test_inference_manager_search_wires_calibration(monkeypatch):
     """VERDICT r4 #5 gate (a): InferenceManager(strategy='search') reaches
     graph_optimize with a machine model + an HBM memory_limit (not the bare
