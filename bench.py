@@ -768,37 +768,14 @@ def bench_spec_trained(ctx=1800, width=1, depth=5, n_lo=4, n_hi=20,
 
 def under_load_metrics(records, makespan_s=None):
     """Reduce ``RequestManager.serve_with_arrivals`` records to the
-    serving_under_load section's fields: TTFT distribution, per-request
-    TPOT p50/p95, goodput.  Pure host-side math — the hermetic small-shape
-    test (tests/test_serving_under_load.py) runs it on a virtual clock."""
-    recs = list(records.values())
-    done = [r for r in recs if "finish_s" in r]
-    ttft = sorted(r["first_token_s"] - r["arrival_s"]
-                  for r in recs if "first_token_s" in r)
-    tpot = sorted((r["finish_s"] - r["first_token_s"])
-                  / max(len(r["tokens"]) - 1, 1) for r in done)
+    serving_under_load section's fields.  The math moved to
+    ``flexflow_tpu.obs.report.under_load_summary`` (the observability
+    layer owns serving accounting now — same reduction for the bench, the
+    hermetic tests, and scripts/trace_report.py); this thin alias keeps
+    the bench-side name the tests exercise."""
+    from flexflow_tpu.obs.report import under_load_summary
 
-    def pct(xs, q):
-        if not xs:
-            return None
-        return round(xs[min(int(q * len(xs)), len(xs) - 1)] * 1e3, 2)
-
-    makespan = makespan_s
-    if makespan is None and done:
-        makespan = (max(r["finish_s"] for r in done)
-                    - min(r["arrival_s"] for r in recs))
-    total_tokens = sum(len(r["tokens"]) for r in done)
-    return {
-        "requests": len(recs),
-        "completed": len(done),
-        "ttft_p50_ms": pct(ttft, 0.50),
-        "ttft_p95_ms": pct(ttft, 0.95),
-        "ttft_max_ms": pct(ttft, 1.0),
-        "tpot_p50_ms": pct(tpot, 0.50),
-        "tpot_p95_ms": pct(tpot, 0.95),
-        "goodput_tokens_per_sec": (round(total_tokens / makespan, 1)
-                                   if makespan else None),
-    }
+    return under_load_summary(records, makespan_s)
 
 
 def bench_serving_under_load(pallas_tpot, ctx=256, max_new=32, n_req=24,
@@ -816,6 +793,9 @@ def bench_serving_under_load(pallas_tpot, ctx=256, max_new=32, n_req=24,
     that is the uncongested point, 1.5x the saturated one (queueing shows
     up in TTFT p95, goodput ceilings at capacity).
     """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
     from flexflow_tpu.serve import GenerationConfig, RequestManager
 
     cap_rps = shape["max_requests"] / pallas_tpot / (max_new + 1)
@@ -842,21 +822,41 @@ def bench_serving_under_load(pallas_tpot, ctx=256, max_new=32, n_req=24,
                                      size=plen).tolist()
                 arrivals.append((t, prompt, max_new))
             im.reset()
-            rm = RequestManager(im, GenerationConfig(max_new_tokens=max_new))
+            tel = Telemetry()
+            rm = RequestManager(im, GenerationConfig(max_new_tokens=max_new),
+                                telemetry=tel)
             t0 = time.perf_counter()
             records = rm.serve_with_arrivals(arrivals)
             metrics = under_load_metrics(records)
             metrics["wall_s"] = round(time.perf_counter() - t0, 2)
             metrics["offered_rps"] = round(rate, 3)
+            # registry view of the same run (occupancy/KV-util gauges,
+            # token-mix counters — what the record reduction can't see)
+            snap = tel.metrics.snapshot()
+            metrics["registry"] = {
+                k: snap.get(k) for k in (
+                    "batch_slot_occupancy", "kv_cache_utilization",
+                    "decode_tokens", "prefill_tokens",
+                    "decode_scan_steps", "requests_finished")
+                if k in snap}
+            metrics["trace_events"] = tel.trace.emitted
             out["offered_loads_rps"][label] = metrics
+            tel.export(os.path.join("artifacts", "telemetry"),
+                       prefix=f"under_load_{label}")
     finally:
         release_im(im)
+    out["telemetry_note"] = (
+        "per-load Telemetry JSONL exported to artifacts/telemetry/"
+        "under_load_{0.5x,1.5x}.jsonl (summarize with "
+        "scripts/trace_report.py)")
     out["note"] = (f"open-loop Poisson arrivals, {n_req} requests, prompts "
                    f"{ctx//2}-{ctx} tokens, {max_new} new tokens each, "
                    f"chunk cap {cap} (= DUS_MAX_TOKENS: decode stretches "
                    "stay on the DUS KV-write path); loads relative to the "
                    "measured decode capacity; scan quantum capped at 8 "
-                   "steps while arrivals are outstanding (TTFT protection)")
+                   "steps while arrivals are outstanding (TTFT protection); "
+                   "ttft now decomposes into queue_wait (arrival->prefill "
+                   "start) + prefill")
     return out
 
 
@@ -1112,9 +1112,100 @@ def searched_vs_dp_fields():
         return {"searched_vs_dp_error": f"{type(e).__name__}: {e}"[:120]}
 
 
-def main():
+def observability_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` observability section: drive the telemetry
+    pipeline end to end (trace ring, metrics registry, calibration ledger,
+    JSONL/Perfetto export, report reduction) on a virtual clock — no
+    device, no model, deterministic output.
+
+    The synthetic session goes through the SAME ``Telemetry.request_*`` /
+    span / calibration APIs the serving stack is instrumented with, so the
+    exported JSONL carries the real schema; the returned section embeds
+    the in-process ``summarize_jsonl`` summary, and the tier-1 round-trip
+    test (tests/test_trace_report.py) pins that ``scripts/trace_report.py``
+    reproduces it from the file alone.
+    """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl
+
+    class _Tick:  # deterministic virtual clock: 1ms per reading
+        t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    tel = Telemetry(clock=_Tick())
+
+    # synthetic pp2 serving session: 6 requests x 4 decode steps
+    pp, n_micro = 2, 2
+    tel.metrics.gauge("pp_bubble_frac").set(max(0, pp - n_micro) / pp)
+    stamps = {}
+    for i in range(6):
+        tid = f"r{i:05d}"
+        t0 = tel.request_enqueued(tid, prompt_len=64 + 8 * i)
+        tel.request_admitted(tid, queue_wait_s=tel.now() - t0)
+        tel.request_prefill_started(tid)
+        stamps[tid] = t0
+    with tel.span("prefill_stretch", cat="serve"):
+        for tid, t0 in stamps.items():
+            tel.request_first_token(tid, ttft_s=tel.now() - t0)
+            stamps[tid] = tel.now()
+    for step in range(4):
+        with tel.span("pp_decode_macro_step", cat="pp", track="pp",
+                      step=step, n_micro=n_micro):
+            for j in range(n_micro):
+                for s in range(pp):
+                    with tel.span("stage_dispatch", cat="pp",
+                                  track=f"stage{s}", stage=s, mb=j):
+                        if s > 0:
+                            tel.instant("stage_hop", cat="pp",
+                                        track=f"stage{s}", stage=s, mb=j)
+        tel.batch_composition(6, 0, active_requests=6, max_requests=8,
+                              kv_tokens=6 * (70 + step), kv_capacity=8 * 256)
+    for tid, first in stamps.items():
+        tel.request_finished(tid, n_tokens=5,
+                             tpot_s=(tel.now() - first) / 4)
+
+    # predicted-vs-measured: the serve search's plan key convention
+    tel.record_plan_prediction("tp1_pp2_m2", tpot_ms=7.0, bubble_frac=0.0,
+                               transfer_ms=0.02, memory_gb=3.1)
+    tel.record_plan_measured("tp1_pp2_m2", tpot_ms=7.7, memory_gb=3.0)
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    paths = tel.export(out_dir, prefix="dryrun")
+    return {
+        "observability": {
+            "paths": paths,
+            "summary": summarize_jsonl(paths["jsonl"]),
+            "metrics": tel.metrics.snapshot(),
+            "calibration": tel.calibration.report(),
+            "note": "synthetic virtual-clock session through the real "
+                    "telemetry APIs (schema fidelity, no device); real "
+                    "serve sections attach Telemetry to their "
+                    "RequestManagers and export the same artifacts",
+        }
+    }
+
+
+def main(argv=None):
+    import argparse
     import os
     import sys
+
+    ap = argparse.ArgumentParser(description="flexflow_tpu bench")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="hermetic observability-only run: exercise the "
+                         "telemetry pipeline on a virtual clock and print "
+                         "the observability section (no device work)")
+    ap.add_argument("--out", default=None,
+                    help="dry-run artifact dir (default artifacts/telemetry)")
+    args = ap.parse_args(argv)
+    if args.dry_run:
+        print(json.dumps(observability_dryrun(args.out)))
+        return
 
     import jax
 

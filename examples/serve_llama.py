@@ -40,6 +40,16 @@ def main():
                     help="KV-cache storage dtype (int8: quantize-on-write "
                          "caches with dequant fused into the Pallas "
                          "attention kernels)")
+    ap.add_argument("--profile", action="store_true",
+                    help="capture an XProf (jax.profiler) trace of the "
+                         "serve run in a fresh timestamped dir under "
+                         "artifacts/profile/, with the telemetry JSON "
+                         "exported alongside it")
+    ap.add_argument("--telemetry-out", default="",
+                    help="export the serving telemetry (Perfetto trace "
+                         "JSON + JSONL) to this directory (default: the "
+                         "--profile run dir when profiling, else no "
+                         "export; the summary always prints)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -99,14 +109,33 @@ def main():
             kv_dtype=args.kv_dtype,
         )
     im.init_operators_inference(rng=jax.random.PRNGKey(0))
-    rm = RequestManager(im, GenerationConfig(max_new_tokens=args.max_new_tokens))
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.utils.profiling import maybe_profile, run_trace_dir
+
+    tel = Telemetry()
+    rm = RequestManager(
+        im, GenerationConfig(max_new_tokens=args.max_new_tokens),
+        telemetry=tel)
+    if args.pp > 1:
+        # predicted-vs-measured: price THIS stage split with the serve cost
+        # model, then let the run's measured TPOT land next to it
+        from flexflow_tpu.search.machine_model import MachineModel
+        from flexflow_tpu.search.serve_search import pp_serve_cost
+
+        mm = MachineModel.for_mesh(im.stage_meshes[0])
+        cost = pp_serve_cost(im.stage_plans, mm, n_micro=im.n_micro)
+        plan_key = f"tp{args.tp}_pp{args.pp}_m{im.n_micro}"
+        tel.record_plan_prediction(plan_key, tpot_ms=cost["tpot_s"] * 1e3,
+                                   bubble_frac=cost["bubble_frac"])
 
     rng = np.random.default_rng(0)
     prompts = [
         rng.integers(1, args.vocab, size=n).tolist() for n in (5, 11, 3, 17)
     ]
+    out_dir = args.telemetry_out or None
     t0 = time.perf_counter()
-    outs = rm.generate(prompts)
+    with maybe_profile(args.profile, trace_dir=out_dir) as prof_dir:
+        outs = rm.generate(prompts)
     dt = time.perf_counter() - t0
     for p, o in zip(prompts, outs):
         print(f"prompt[{len(p)} toks] -> {o}")
@@ -115,6 +144,26 @@ def main():
         f"served {len(prompts)} requests, {total} tokens in {rm.steps} steps, "
         f"{dt:.2f}s ({total / dt:.1f} tok/s incl. compile)"
     )
+
+    snap = tel.metrics.snapshot()
+    tpot = snap.get("tpot_s", {})
+    ttft = snap.get("ttft_s", {})
+    if args.pp > 1 and tpot.get("p50") is not None:
+        tel.record_plan_measured(plan_key, tpot_ms=tpot["p50"] * 1e3)
+    parts = [f"trace_events={tel.trace.emitted}"]
+    if ttft.get("p50") is not None:
+        parts.append(f"ttft_p50={1e3 * ttft['p50']:.1f}ms")
+    if tpot.get("p50") is not None:
+        parts.append(f"tpot_p50={1e3 * tpot['p50']:.2f}ms")
+    print("telemetry:", " ".join(parts))
+    if args.pp > 1 and tel.calibration:
+        print("predicted-vs-measured:",
+              tel.calibration.report()["plans"].get(plan_key))
+    out_dir = out_dir or prof_dir
+    if out_dir:
+        paths = tel.export(out_dir, prefix="serve")
+        print(f"telemetry exported: {paths['trace_json']} "
+              f"(+ {paths['jsonl']})")
     return 0
 
 

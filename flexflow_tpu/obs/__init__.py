@@ -1,0 +1,43 @@
+"""FlexFlow-TPU observability: serving telemetry, metrics, calibration.
+
+The serving stack (RequestManager / InferenceManager /
+PipelinedInferenceManager / serve_with_arrivals) is instrumented behind one
+:class:`Telemetry` handle — a trace recorder (Chrome/Perfetto export), a
+metrics registry, and a predicted-vs-measured calibration ledger.  Host-side
+only by construction: telemetry never enters a jitted program, so serve
+outputs are bit-identical with it on or off.  See README "Observability".
+"""
+
+from .calibration import CalibrationLedger
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    percentile,
+)
+from .report import summarize_events, summarize_jsonl, under_load_summary
+from .telemetry import (
+    NULL_TELEMETRY,
+    NullTelemetry,
+    Telemetry,
+    telemetry_or_null,
+)
+from .trace import TraceRecorder
+
+__all__ = [
+    "Telemetry",
+    "NullTelemetry",
+    "NULL_TELEMETRY",
+    "telemetry_or_null",
+    "TraceRecorder",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "percentile",
+    "CalibrationLedger",
+    "summarize_events",
+    "summarize_jsonl",
+    "under_load_summary",
+]

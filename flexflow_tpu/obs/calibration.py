@@ -1,0 +1,83 @@
+"""Predicted-vs-measured cost accounting for executed serve plans.
+
+FlexFlow's simulator (MLSys'19) and Unity's search (OSDI'22) are only as
+good as their calibrated per-op measurements — our ``serve_search`` /
+``simulator`` price plans they historically never checked against reality.
+This ledger closes the loop: every executed plan records the search's
+predicted TPOT/TTFT/memory next to the measured values, and
+:meth:`report` turns the pairs into a per-component calibration table
+(ratio + signed error per field, aggregated across plans) that says which
+``MachineModel`` constant to tune and by how much.
+
+Host-side bookkeeping only; keys are free-form plan names (the serve
+search's ``tp{t}_pp{p}_m{m}`` convention by default).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class CalibrationLedger:
+    def __init__(self):
+        # plan_key -> {"predicted": {field: value}, "measured": {...}}
+        self._plans: Dict[str, Dict[str, Dict[str, float]]] = {}
+
+    def _entry(self, plan_key: str) -> Dict:
+        return self._plans.setdefault(
+            str(plan_key), {"predicted": {}, "measured": {}})
+
+    def predict(self, plan_key: str, **fields) -> None:
+        """Record the search/simulator's predictions for a plan (e.g.
+        ``predict("tp2_pp1_m1", tpot_ms=7.1, memory_gb=12.3)``)."""
+        self._entry(plan_key)["predicted"].update(
+            {k: float(v) for k, v in fields.items() if v is not None})
+
+    def measure(self, plan_key: str, **fields) -> None:
+        """Record measured values for the same fields, same units."""
+        self._entry(plan_key)["measured"].update(
+            {k: float(v) for k, v in fields.items() if v is not None})
+
+    # ------------------------------------------------------------------
+    def report(self) -> Dict:
+        """Per-plan, per-field predicted vs measured, plus the cross-plan
+        component aggregation::
+
+            {"plans": {plan: {field: {"predicted", "measured", "ratio",
+                                      "error_frac"}}},
+             "components": {field: {"mean_ratio", "suggested_scale", "n"}}}
+
+        ``ratio = measured/predicted`` — the factor to multiply the cost
+        model's output by (``suggested_scale``) so it lands on reality;
+        ``error_frac = (measured-predicted)/predicted`` is the signed
+        relative error.  Fields recorded on only one side appear with the
+        other side ``None`` and no ratio (coverage gaps stay visible
+        instead of silently dropping).
+        """
+        plans: Dict[str, Dict] = {}
+        comp: Dict[str, Dict] = {}
+        for key, rec in self._plans.items():
+            fields = {}
+            for f in sorted(set(rec["predicted"]) | set(rec["measured"])):
+                pred = rec["predicted"].get(f)
+                meas = rec["measured"].get(f)
+                entry = {"predicted": pred, "measured": meas,
+                         "ratio": None, "error_frac": None}
+                if pred is not None and meas is not None and pred != 0:
+                    entry["ratio"] = round(meas / pred, 4)
+                    entry["error_frac"] = round((meas - pred) / pred, 4)
+                    c = comp.setdefault(f, {"sum_ratio": 0.0, "n": 0})
+                    c["sum_ratio"] += meas / pred
+                    c["n"] += 1
+                fields[f] = entry
+            plans[key] = fields
+        components = {
+            f: {"mean_ratio": round(c["sum_ratio"] / c["n"], 4),
+                "suggested_scale": round(c["sum_ratio"] / c["n"], 4),
+                "n": c["n"]}
+            for f, c in sorted(comp.items())
+        }
+        return {"plans": plans, "components": components}
+
+    def __bool__(self) -> bool:
+        return bool(self._plans)

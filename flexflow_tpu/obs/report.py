@@ -1,0 +1,179 @@
+"""Reductions over telemetry artifacts: JSONL summaries + serving records.
+
+Two consumers share this module:
+
+* ``scripts/trace_report.py`` — CLI over :func:`summarize_jsonl`: p50/p95
+  TTFT/TPOT/queue-wait derived from the request-lifecycle events a
+  ``Telemetry`` export carries, per-track span totals (the pp stage
+  interleave), the pipeline bubble fraction, and the per-plan
+  predicted-vs-measured error table.
+* ``bench.py`` — :func:`under_load_summary` is the ``serving_under_load``
+  section's record reduction (moved here from bench so the bench, the
+  hermetic tests, and the report CLI all run the SAME accounting).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from .metrics import percentile
+
+# request-lifecycle event names (the Telemetry.request_* schema)
+_ENQ = "request_enqueue"
+_ADMIT = "request_admit"
+_PREFILL = "request_prefill_start"
+_FIRST = "request_first_token"
+_FINISH = "request_finish"
+
+
+def _pct_ms(xs: List[float], q: float) -> Optional[float]:
+    v = percentile(sorted(xs), q)
+    return None if v is None else round(v * 1e3, 2)
+
+
+def summarize_events(events: Sequence[Dict]) -> Dict:
+    """Per-request latency distributions from lifecycle events (ts in
+    microseconds, trace_event form) + per-track span time.
+
+    ``span_ms_by_track`` sums complete-span durations per track, so it is
+    only a wall-time total where spans on one track don't nest/overlap —
+    the instrumentation keeps serve-loop, dispatch, pp-macro, and stage
+    spans on separate tracks for exactly this reason.
+    """
+    reqs: Dict[str, Dict] = {}
+    track_spans: Dict[int, float] = {}
+    track_names: Dict[int, str] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M" and ev.get("name") == "thread_name":
+            track_names[ev.get("tid")] = ev.get("args", {}).get("name")
+            continue
+        if ph == "X":
+            tid = ev.get("tid")
+            track_spans[tid] = track_spans.get(tid, 0.0) \
+                + ev.get("dur", 0.0) / 1e6
+            continue
+        args = ev.get("args", {})
+        trace_id = args.get("trace_id")
+        if trace_id is None:
+            continue
+        rec = reqs.setdefault(trace_id, {})
+        name = ev.get("name")
+        if name in (_ENQ, _ADMIT, _PREFILL, _FIRST, _FINISH):
+            rec[name] = ev.get("ts", 0.0) / 1e6  # -> seconds
+            if name == _FINISH:
+                rec["n_tokens"] = args.get("n_tokens", 0)
+
+    ttft, tpot, queue_wait, prefill = [], [], [], []
+    completed = 0
+    for rec in reqs.values():
+        enq = rec.get(_ENQ)
+        first = rec.get(_FIRST)
+        fin = rec.get(_FINISH)
+        if enq is not None and first is not None:
+            ttft.append(first - enq)
+            # queue wait ends where prefill begins (fall back to admission
+            # when no prefill-start stamp was emitted)
+            start = rec.get(_PREFILL, rec.get(_ADMIT))
+            if start is not None:
+                queue_wait.append(start - enq)
+                prefill.append(first - start)
+        if fin is not None:
+            completed += 1
+            if first is not None:
+                tpot.append((fin - first) / max(rec.get("n_tokens", 1) - 1, 1))
+
+    spans_by_track = {
+        track_names.get(tid, f"track{tid}"): round(total * 1e3, 3)
+        for tid, total in sorted(track_spans.items())
+    }
+    return {
+        "requests": len(reqs),
+        "completed": completed,
+        "ttft_p50_ms": _pct_ms(ttft, 0.50),
+        "ttft_p95_ms": _pct_ms(ttft, 0.95),
+        "queue_wait_p50_ms": _pct_ms(queue_wait, 0.50),
+        "queue_wait_p95_ms": _pct_ms(queue_wait, 0.95),
+        "prefill_p50_ms": _pct_ms(prefill, 0.50),
+        "tpot_p50_ms": _pct_ms(tpot, 0.50),
+        "tpot_p95_ms": _pct_ms(tpot, 0.95),
+        "span_ms_by_track": spans_by_track,
+    }
+
+
+def summarize_jsonl(path: str) -> Dict:
+    """Summarize a ``Telemetry.export`` JSONL: lifecycle distributions,
+    bubble fraction, events/dropped, and per-plan prediction error."""
+    events: List[Dict] = []
+    meta: Dict = {}
+    metrics: Dict = {}
+    calibration: Dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            kind = doc.get("kind")
+            if kind == "event":
+                events.append(doc)
+            elif kind == "telemetry_meta":
+                meta = doc
+            elif kind == "metrics":
+                metrics = doc.get("snapshot", {})
+            elif kind == "calibration":
+                calibration = doc.get("report", {})
+
+    summary = summarize_events(events)
+    summary["events"] = meta.get("events", len(events))
+    summary["dropped"] = meta.get("dropped", 0)
+    summary["bubble_frac"] = metrics.get("pp_bubble_frac")
+
+    pred_err: Dict[str, Dict] = {}
+    for plan, fields in calibration.get("plans", {}).items():
+        row = {f: {"predicted": e.get("predicted"),
+                   "measured": e.get("measured"),
+                   "error_frac": e.get("error_frac")}
+               for f, e in fields.items()}
+        pred_err[plan] = row
+    summary["prediction_error"] = pred_err
+    summary["calibration_components"] = calibration.get("components", {})
+    return summary
+
+
+def under_load_summary(records: Dict, makespan_s: Optional[float] = None
+                       ) -> Dict:
+    """Reduce ``RequestManager.serve_with_arrivals`` records to the
+    ``serving_under_load`` fields: TTFT distribution (split into queue wait
+    vs prefill where the records carry the split), per-request TPOT
+    p50/p95, goodput.  Pure host-side math — the hermetic small-shape test
+    (tests/test_serving_under_load.py) runs it on a virtual clock."""
+    recs = list(records.values())
+    done = [r for r in recs if "finish_s" in r]
+    ttft = [r["first_token_s"] - r["arrival_s"]
+            for r in recs if "first_token_s" in r]
+    tpot = [(r["finish_s"] - r["first_token_s"])
+            / max(len(r["tokens"]) - 1, 1) for r in done]
+    queue_wait = [r["queue_wait_s"] for r in recs if "queue_wait_s" in r]
+    prefill = [r["prefill_s"] for r in recs if "prefill_s" in r]
+
+    makespan = makespan_s
+    if makespan is None and done:
+        makespan = (max(r["finish_s"] for r in done)
+                    - min(r["arrival_s"] for r in recs))
+    total_tokens = sum(len(r["tokens"]) for r in done)
+    return {
+        "requests": len(recs),
+        "completed": len(done),
+        "ttft_p50_ms": _pct_ms(ttft, 0.50),
+        "ttft_p95_ms": _pct_ms(ttft, 0.95),
+        "ttft_max_ms": _pct_ms(ttft, 1.0),
+        "queue_wait_p50_ms": _pct_ms(queue_wait, 0.50),
+        "queue_wait_p95_ms": _pct_ms(queue_wait, 0.95),
+        "prefill_p50_ms": _pct_ms(prefill, 0.50),
+        "tpot_p50_ms": _pct_ms(tpot, 0.50),
+        "tpot_p95_ms": _pct_ms(tpot, 0.95),
+        "goodput_tokens_per_sec": (round(total_tokens / makespan, 1)
+                                   if makespan else None),
+    }

@@ -1,0 +1,232 @@
+"""The single ``Telemetry`` handle the serving stack is instrumented behind.
+
+One handle bundles the three observability primitives:
+
+* ``trace`` — a :class:`~flexflow_tpu.obs.trace.TraceRecorder` (request
+  lifecycle, batch composition, scan quanta, per-stage pipeline dispatch);
+* ``metrics`` — a :class:`~flexflow_tpu.obs.metrics.MetricsRegistry`
+  (TTFT/TPOT/queue-wait histograms, occupancy/KV-utilization gauges,
+  token/hop counters, pp bubble fraction);
+* ``calibration`` — a :class:`~flexflow_tpu.obs.calibration.CalibrationLedger`
+  (predicted-vs-measured cost accounting per executed plan).
+
+``RequestManager(im, gen, telemetry=Telemetry())`` shares the handle with
+the InferenceManager (and, for pipeline serving, every stage dispatch) —
+one handle, one clock, one export.
+
+**Serving lifecycle schema.**  The ``request_*`` methods are the canonical
+event vocabulary: ``RequestManager`` emits through them, ``bench.py
+--dry-run`` synthesizes through them, and ``scripts/trace_report.py``
+parses exactly their names/args — adding a lifecycle event means adding a
+method here, so the three cannot drift apart.
+
+**Disabled = no-op, guaranteed.**  ``NULL_TELEMETRY`` (a
+:class:`NullTelemetry`) answers every instrumentation call with a constant
+no-op; ``enabled`` is False so hot paths can skip even argument
+construction.  Telemetry is host-side only — nothing here is ever traced
+into a jitted program — so serve outputs are bit-identical with telemetry
+on or off (pinned by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Optional
+
+from .calibration import CalibrationLedger
+from .metrics import MetricsRegistry
+from .trace import TraceRecorder
+
+
+class Telemetry:
+    enabled = True
+
+    def __init__(self, capacity: int = 65536,
+                 clock: Optional[Callable[[], float]] = None):
+        self._clock = clock or time.perf_counter
+        self.trace = TraceRecorder(capacity=capacity, clock=self._clock)
+        self.metrics = MetricsRegistry()
+        self.calibration = CalibrationLedger()
+
+    # ---- primitive delegation -----------------------------------------
+    def now(self) -> float:
+        return self._clock()
+
+    def span(self, name, cat="serve", track="serve", **args):
+        return self.trace.span(name, cat, track, **args)
+
+    def instant(self, name, cat="serve", track="serve", **args):
+        return self.trace.instant(name, cat, track, **args)
+
+    def counter(self, name, value, track="counters"):
+        """Counter-series trace event ("C" phase) only — registry metrics
+        are updated explicitly by callers (a name like ``decode_tokens``
+        may be a registry Counter; auto-registering a Gauge here would
+        type-clash it)."""
+        self.trace.counter(name, value, track)
+
+    # ---- serving lifecycle (see module docstring) ---------------------
+    def request_enqueued(self, trace_id: str, prompt_len: int = 0) -> float:
+        self.metrics.counter("requests_enqueued").inc()
+        return self.trace.instant("request_enqueue", "request", "requests",
+                                  trace_id=trace_id, prompt_len=prompt_len)
+
+    def request_admitted(self, trace_id: str,
+                         queue_wait_s: Optional[float] = None) -> float:
+        self.metrics.counter("requests_admitted").inc()
+        if queue_wait_s is not None:
+            self.metrics.histogram("queue_wait_s").observe(queue_wait_s)
+        return self.trace.instant("request_admit", "request", "requests",
+                                  trace_id=trace_id,
+                                  queue_wait_s=queue_wait_s)
+
+    def request_prefill_started(self, trace_id: str) -> float:
+        return self.trace.instant("request_prefill_start", "request",
+                                  "requests", trace_id=trace_id)
+
+    def request_first_token(self, trace_id: str,
+                            ttft_s: Optional[float] = None) -> float:
+        if ttft_s is not None:
+            self.metrics.histogram("ttft_s").observe(ttft_s)
+        return self.trace.instant("request_first_token", "request",
+                                  "requests", trace_id=trace_id,
+                                  ttft_s=ttft_s)
+
+    def request_finished(self, trace_id: str, n_tokens: int,
+                         tpot_s: Optional[float] = None) -> float:
+        self.metrics.counter("requests_finished").inc()
+        self.metrics.counter("tokens_generated").inc(n_tokens)
+        if tpot_s is not None:
+            self.metrics.histogram("tpot_s").observe(tpot_s)
+        return self.trace.instant("request_finish", "request", "requests",
+                                  trace_id=trace_id, n_tokens=n_tokens,
+                                  tpot_s=tpot_s)
+
+    def batch_composition(self, decode_tokens: int, prefill_tokens: int,
+                          active_requests: int, max_requests: int,
+                          kv_tokens: int, kv_capacity: int) -> None:
+        """Per-step batch mix: token split, slot occupancy, KV utilization."""
+        m = self.metrics
+        m.counter("decode_tokens").inc(decode_tokens)
+        m.counter("prefill_tokens").inc(prefill_tokens)
+        occ = active_requests / max_requests if max_requests else 0.0
+        util = kv_tokens / kv_capacity if kv_capacity else 0.0
+        m.gauge("batch_slot_occupancy").set(occ)
+        m.gauge("kv_cache_utilization").set(util)
+        self.trace.counter("batch_slot_occupancy", occ)
+        self.trace.counter("kv_cache_utilization", util)
+
+    # ---- predicted-vs-measured ----------------------------------------
+    def record_plan_prediction(self, plan_key: str, **fields) -> None:
+        self.calibration.predict(plan_key, **fields)
+
+    def record_plan_measured(self, plan_key: str, **fields) -> None:
+        self.calibration.measure(plan_key, **fields)
+
+    # ---- snapshot / export --------------------------------------------
+    def snapshot(self) -> Dict:
+        """One JSON-ready dict of everything the handle accumulated."""
+        return {
+            "metrics": self.metrics.snapshot(),
+            "calibration": self.calibration.report(),
+            "trace": {"events": self.trace.emitted,
+                      "dropped": self.trace.dropped},
+        }
+
+    def export(self, out_dir: str, prefix: str = "telemetry") -> Dict[str, str]:
+        """Write ``<prefix>.trace.json`` (Chrome/Perfetto) and
+        ``<prefix>.jsonl`` under ``out_dir``; returns both paths.
+
+        The JSONL is the machine-readable artifact ``scripts/trace_report.py``
+        consumes: a meta line, one ``{"kind": "event", ...}`` line per trace
+        event (trace_event fields, ts/dur in microseconds), then a metrics
+        snapshot line and a calibration report line.
+        """
+        os.makedirs(out_dir, exist_ok=True)
+        trace_path = os.path.join(out_dir, f"{prefix}.trace.json")
+        jsonl_path = os.path.join(out_dir, f"{prefix}.jsonl")
+        self.trace.export_json(trace_path)
+        with open(jsonl_path, "w") as f:
+            f.write(json.dumps({
+                "kind": "telemetry_meta", "version": 1, "ts_unit": "us",
+                "events": self.trace.emitted, "dropped": self.trace.dropped,
+            }) + "\n")
+            for ev in self.trace.trace_events():
+                f.write(json.dumps({"kind": "event", **ev}) + "\n")
+            f.write(json.dumps({"kind": "metrics",
+                                "snapshot": self.metrics.snapshot()}) + "\n")
+            f.write(json.dumps({"kind": "calibration",
+                                "report": self.calibration.report()}) + "\n")
+        return {"trace_json": trace_path, "jsonl": jsonl_path}
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTelemetry:
+    """No-op stand-in: every hook returns a constant; ``enabled`` is False
+    so instrumented code can skip argument computation entirely."""
+
+    enabled = False
+
+    def now(self):
+        return 0.0
+
+    def span(self, *a, **k):
+        return _NULL_SPAN
+
+    def instant(self, *a, **k):
+        return 0.0
+
+    def counter(self, *a, **k):
+        return None
+
+    def request_enqueued(self, *a, **k):
+        return 0.0
+
+    def request_admitted(self, *a, **k):
+        return 0.0
+
+    def request_prefill_started(self, *a, **k):
+        return 0.0
+
+    def request_first_token(self, *a, **k):
+        return 0.0
+
+    def request_finished(self, *a, **k):
+        return 0.0
+
+    def batch_composition(self, *a, **k):
+        return None
+
+    def record_plan_prediction(self, *a, **k):
+        return None
+
+    def record_plan_measured(self, *a, **k):
+        return None
+
+    def snapshot(self):
+        return {}
+
+    def export(self, *a, **k):
+        return {}
+
+
+NULL_TELEMETRY = NullTelemetry()
+
+
+def telemetry_or_null(telemetry) -> "Telemetry":
+    """Normalize an optional handle: None -> the shared no-op singleton."""
+    return telemetry if telemetry is not None else NULL_TELEMETRY

@@ -140,9 +140,16 @@ def search_serve_plan(
     n_micro: Sequence[int] = (1, 2, 4),
     devices=None,
     spec_name: Optional[str] = None,
+    telemetry=None,
 ) -> Dict:
     """Pick the best (tp, pp, n_micro) for serving ``model``'s graph on
     ``n_chips`` chips.
+
+    ``telemetry``: optional :class:`~flexflow_tpu.obs.Telemetry` — the
+    winning plan's predicted TPOT/bubble/transfer/memory are recorded in
+    its calibration ledger under ``tp{t}_pp{p}_m{m}``, so the executing
+    side only has to add measured values for the predicted-vs-measured
+    report (the MachineModel tuning loop).
 
     The graph must already carry its serve capacities
     (``register_serve_capacities`` — InferenceManager/PipelinedInferenceManager
@@ -238,4 +245,13 @@ def search_serve_plan(
             f"candidates: { {k: v.get('per_stage_gb') for k, v in candidates.items()} }"
         )
     best["candidates"] = candidates
+    best["plan_key"] = f"tp{best['tp']}_pp{best['pp']}_m{best['n_micro']}"
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        telemetry.record_plan_prediction(
+            best["plan_key"],
+            tpot_ms=best["tpot_ms"],
+            bubble_frac=best["bubble_frac"],
+            transfer_ms=best["transfer_ms"],
+            memory_gb=max(best["per_stage_gb"]),
+        )
     return best

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..core.interpreter import build_forward
 from ..core.pcg import PCG
+from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
 from .ops import IncMultiHeadSelfAttention
 
@@ -243,6 +244,12 @@ def sample_tokens(logits, sample):
 
 
 class InferenceManager:
+    # serving telemetry handle (obs/): host-side dispatch spans only — it
+    # is NEVER passed into a jitted program, so attaching a live handle
+    # cannot change compiled executables or their outputs.  RequestManager
+    # shares its handle here; the class default is the no-op singleton.
+    telemetry = NULL_TELEMETRY
+
     def __init__(
         self,
         model,
@@ -501,7 +508,14 @@ class InferenceManager:
         ``sample``: optional ``(key, temperature, top_p)`` — argmax if None.
         """
         assert self.params is not None, "call init_operators_inference() first"
-        result, self.state = self._step(self.params, self.state, bc, sample)
+        # span = host dispatch time (the jit call returns without syncing);
+        # device time shows up at the result readback, not here.  Dispatch
+        # spans live on their own track: they nest inside the serve loop's
+        # spans, and per-track totals assume non-overlapping spans per track
+        with self.telemetry.span("step_dispatch", cat="dispatch",
+                                 track="dispatch"):
+            result, self.state = self._step(self.params, self.state, bc,
+                                            sample)
         return result
 
     # ------------------------------------------------------------------
@@ -581,9 +595,13 @@ class InferenceManager:
                 f"{self.max_seq_len}; cache writes past the end clamp to the "
                 "last slot and silently corrupt it"
             )
-        tokens, live, self.state, bc = self._scan(
-            self.params, self.state, bc, sample, n_steps=n_steps, eos=eos
-        )
+        with self.telemetry.span("decode_scan_dispatch", cat="dispatch",
+                                 track="dispatch", n_steps=n_steps):
+            tokens, live, self.state, bc = self._scan(
+                self.params, self.state, bc, sample, n_steps=n_steps, eos=eos
+            )
+        if self.telemetry.enabled:
+            self.telemetry.metrics.counter("decode_scan_steps").inc(n_steps)
         return tokens, live, bc
 
     # ------------------------------------------------------------------
@@ -694,11 +712,14 @@ class InferenceManager:
         carrying a prompt's final position emit a SAMPLED first token.
         """
         assert self.params is not None, "call init_operators_inference() first"
-        tokens, self.state = self._pscan(
-            self.params, self.state, bcs, sample,
-            overlap=bool(self.prefill_overlap
-                         and self._overlap_steps is not None),
-        )
+        with self.telemetry.span("prefill_scan_dispatch", cat="dispatch",
+                                 track="dispatch",
+                                 n_chunks=int(bcs.base.tokens.shape[0])):
+            tokens, self.state = self._pscan(
+                self.params, self.state, bcs, sample,
+                overlap=bool(self.prefill_overlap
+                             and self._overlap_steps is not None),
+            )
         return tokens
 
     def reset(self):

@@ -46,6 +46,7 @@ import numpy as np
 from ..core.graph import live_cuts
 from ..core.interpreter import build_forward, init_params
 from ..core.pcg import PCG
+from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
 from .inference_manager import (
     allocate_attention_state,
@@ -200,6 +201,11 @@ class PipelinedInferenceManager:
     this multi-program design trades away; chunked prefill covers the
     prompt phase instead.
     """
+
+    # shared with RequestManager like InferenceManager.telemetry; stage
+    # dispatches land on per-stage trace tracks ("stage0", "stage1", ...)
+    # so a Perfetto export shows the micro-batch interleave per stage
+    telemetry = NULL_TELEMETRY
 
     def __init__(
         self,
@@ -437,24 +443,38 @@ class PipelinedInferenceManager:
             return bc.split_microbatches(self.n_micro)
         return [bc]  # prefill chunks / tree batches ride whole
 
-    def _dispatch(self, bc, sample=None):
+    def _dispatch(self, bc, sample=None, mb: int = 0):
         """One micro-batch through the stage chain; returns the last
-        stage's InferenceResult (device arrays, not synced)."""
+        stage's InferenceResult (device arrays, not synced).
+
+        Telemetry spans cover the HOST dispatch of each stage (async — the
+        jit calls return without syncing; device occupancy needs XProf) on
+        per-stage tracks; the inter-stage ``device_put`` hop is an instant
+        on the receiving stage's track.
+        """
+        tel = self.telemetry
         xs: Tuple = ()
         res = None
         n = len(self.stages)
         for s, stage in enumerate(self.stages):
-            bc_s = jax.device_put(bc, stage.replicated)
-            if s > 0:
-                xs = tuple(jax.device_put(x, stage.replicated) for x in xs)
-            if s < n - 1:
-                xs, stage.state = stage.step(stage.params, stage.state,
-                                             bc_s, xs)
-            else:
-                smp = (jax.device_put(sample, stage.replicated)
-                       if sample is not None else None)
-                res, stage.state = stage.step(stage.params, stage.state,
-                                              bc_s, xs, smp)
+            with tel.span("stage_dispatch", cat="pp", track=f"stage{s}",
+                          stage=s, mb=mb):
+                bc_s = jax.device_put(bc, stage.replicated)
+                if s > 0:
+                    tel.instant("stage_hop", cat="pp", track=f"stage{s}",
+                                stage=s, mb=mb)
+                    if tel.enabled:
+                        tel.metrics.counter("pp_hops").inc()
+                    xs = tuple(jax.device_put(x, stage.replicated)
+                               for x in xs)
+                if s < n - 1:
+                    xs, stage.state = stage.step(stage.params, stage.state,
+                                                 bc_s, xs)
+                else:
+                    smp = (jax.device_put(sample, stage.replicated)
+                           if sample is not None else None)
+                    res, stage.state = stage.step(stage.params, stage.state,
+                                                  bc_s, xs, smp)
         return res
 
     @staticmethod
@@ -477,15 +497,24 @@ class PipelinedInferenceManager:
         assert self.stages[0].params is not None, \
             "call init_operators_inference() first"
         mbs = self._microbatches(bc)
-        results = []
-        for j, mb in enumerate(mbs):
-            smp = sample
-            if sample is not None and len(mbs) > 1:
-                # per-micro-batch key: same sampling distribution as the
-                # single-program step, different bitstream (documented)
-                key, t, p = sample
-                smp = (jax.random.fold_in(key, j), t, p)
-            results.append(self._dispatch(mb, smp))
+        tel = self.telemetry
+        if tel.enabled:
+            # steady-state decode bubble of this macro-step's schedule —
+            # the model-side fraction the calibration loop compares against
+            # measured stage occupancy (XProf) on device runs
+            tel.metrics.gauge("pp_bubble_frac").set(
+                max(0, self.pp - len(mbs)) / self.pp)
+        with tel.span("pp_macro_step", cat="pp", track="pp",
+                      n_micro=len(mbs)):
+            results = []
+            for j, mbc in enumerate(mbs):
+                smp = sample
+                if sample is not None and len(mbs) > 1:
+                    # per-micro-batch key: same sampling distribution as the
+                    # single-program step, different bitstream (documented)
+                    key, t, p = sample
+                    smp = (jax.random.fold_in(key, j), t, p)
+                results.append(self._dispatch(mbc, smp, mb=j))
         return self._merge_results(results)
 
     # ------------------------------------------------------------------
@@ -530,17 +559,23 @@ class PipelinedInferenceManager:
         alive = [mb.request_index >= 0 for mb in mbs]
         toks = [[None] * m for _ in range(n_steps)]
         lives = [[None] * m for _ in range(n_steps)]
+        tel = self.telemetry
+        if tel.enabled:
+            tel.metrics.gauge("pp_bubble_frac").set(
+                max(0, self.pp - m) / self.pp)
         for i in range(n_steps):
-            for j in range(m):
-                smp = None
-                if sample is not None:
-                    key, t, p = sample
-                    smp = (jax.random.fold_in(key, i * m + j), t, p)
-                res = self._dispatch(mbs[j], smp)
-                mbs[j], alive[j], live = self._advance(
-                    mbs[j], res.token_ids, alive[j], eos=eos)
-                toks[i][j] = res.token_ids
-                lives[i][j] = live
+            with tel.span("pp_decode_macro_step", cat="pp", track="pp",
+                          step=i, n_micro=m):
+                for j in range(m):
+                    smp = None
+                    if sample is not None:
+                        key, t, p = sample
+                        smp = (jax.random.fold_in(key, i * m + j), t, p)
+                    res = self._dispatch(mbs[j], smp, mb=j)
+                    mbs[j], alive[j], live = self._advance(
+                        mbs[j], res.token_ids, alive[j], eos=eos)
+                    toks[i][j] = res.token_ids
+                    lives[i][j] = live
         tokens = np.stack([
             np.concatenate([np.asarray(t) for t in row]) for row in toks
         ])

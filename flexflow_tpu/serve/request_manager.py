@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.telemetry import telemetry_or_null
 from .batch_config import BatchConfig, PrefillBatchConfig
 
 
@@ -38,6 +39,7 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     prefill_offset: int = 0     # prompt tokens already fed to the model
     slot: int = -1
+    trace_id: str = ""          # stable per-request telemetry/trace tag
     # consecutive mixed-batch steps in which the tiled budget rounded this
     # request's prefill take to zero (starvation fallback, ADVICE r5 low)
     starved_steps: int = 0
@@ -67,7 +69,8 @@ class GenerationConfig:
 class RequestManager:
     request_cls = Request  # subclasses (SpecInferManager) extend the record
 
-    def __init__(self, im, gen_config: Optional[GenerationConfig] = None):
+    def __init__(self, im, gen_config: Optional[GenerationConfig] = None,
+                 telemetry=None):
         self.im = im
         self.gen = gen_config or GenerationConfig()
         self.requests: Dict[int, Request] = {}
@@ -78,6 +81,16 @@ class RequestManager:
         self.tokens_decoded = 0
         self.scan_runs = 0      # decode stretches run as on-device scans
         self._sample_calls = 0  # folds the per-call key for seeded sampling
+        # ONE Telemetry handle across the serving stack: syncing it onto the
+        # InferenceManager (which forwards to pipeline stages) puts request
+        # lifecycle, dispatch spans, and per-stage events on one clock/ring.
+        # ALWAYS synced — exactly the handle passed here (or the no-op) —
+        # so a shared/cached im can never leak a previous run's live handle
+        # into a manager built without one.  Host-side only — a handle can
+        # never change serve outputs (tests/test_obs.py bit-identity).
+        self.telemetry = telemetry_or_null(telemetry)
+        im.telemetry = self.telemetry
+        self._tstamps: Dict[int, Dict[str, float]] = {}  # rid -> stamps
 
     def _sample_arg(self):
         """(key, temperature, top_p) for the step, or None for greedy."""
@@ -115,8 +128,15 @@ class RequestManager:
                 f"request needs {self._seq_len_needed(req)} cache slots, "
                 f"exceeds max_seq_len {self.im.max_seq_len}"
             )
+        req.trace_id = f"r{rid:05d}"
         self.requests[rid] = req
         self.pending.append(rid)
+        tel = self.telemetry
+        if tel.enabled:
+            self._tstamps[rid] = {
+                "enqueue": tel.request_enqueued(req.trace_id,
+                                                prompt_len=len(req.prompt))
+            }
         return rid
 
     def _admit(self):
@@ -127,6 +147,14 @@ class RequestManager:
                 req.slot = i
                 req.status = RequestStatus.PREFILLING
                 self.slots[i] = rid
+                tel = self.telemetry
+                if tel.enabled:
+                    ts = self._tstamps.setdefault(rid, {})
+                    now = tel.request_admitted(
+                        req.trace_id,
+                        queue_wait_s=(tel.now() - ts["enqueue"]
+                                      if "enqueue" in ts else None))
+                    ts["admit"] = now
 
     def _active(self) -> List[Request]:
         return [
@@ -164,6 +192,8 @@ class RequestManager:
                 positions.append(pos)
                 sample_points.append((len(tokens) - 1, req.rid))
                 budget -= 1
+
+        n_decode = len(tokens)
 
         # a pure-prefill step with Pallas enabled ships tile-aligned chunks
         # (PrefillBatchConfig -> the Q-tiled prefill kernel); mixed
@@ -212,6 +242,7 @@ class RequestManager:
                 (slot if gate else last_flat[slot], rid)
                 for slot, rid in sample_points
             ]
+            self._note_batch(0, sum(len(s[1]) for s in segments), seq_lens)
             return pbc, sample_points
 
         # then prefill chunks fill the remaining budget.  Mid-prompt cuts
@@ -283,7 +314,37 @@ class RequestManager:
             max_tokens=self.im.max_tokens,
             max_requests=self.im.max_requests,
         )
+        self._note_batch(n_decode, len(tokens) - n_decode, seq_lens)
         return bc, sample_points
+
+    def _note_batch(self, n_decode: int, n_prefill: int, seq_lens) -> None:
+        """Batch-composition telemetry for one step (token mix, slot
+        occupancy, KV utilization) — host counters only."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        tel.batch_composition(
+            n_decode, n_prefill,
+            active_requests=sum(1 for s in self.slots if s is not None),
+            max_requests=self.im.max_requests,
+            kv_tokens=int(np.sum(seq_lens)),
+            kv_capacity=self.im.max_requests * self.im.max_seq_len,
+        )
+
+    def _append_token(self, req: Request, tok: int) -> None:
+        """Commit one generated token — the ONE place the first-token
+        (TTFT) telemetry stamp can live, whatever path produced the token
+        (per-step result, prefill stretch, decode scan, spec verify)."""
+        req.generated.append(tok)
+        self.tokens_decoded += 1
+        tel = self.telemetry
+        if tel.enabled and len(req.generated) == 1:
+            ts = self._tstamps.setdefault(req.rid, {})
+            now = tel.request_first_token(
+                req.trace_id,
+                ttft_s=(tel.now() - ts["enqueue"]
+                        if "enqueue" in ts else None))
+            ts["first_token"] = now
 
     def process_result(self, result, sample_points) -> None:
         if not sample_points:
@@ -296,8 +357,7 @@ class RequestManager:
             tok = int(token_ids[flat_idx])
             if req.status is RequestStatus.PREFILLING:
                 req.status = RequestStatus.DECODING
-            req.generated.append(tok)
-            self.tokens_decoded += 1
+            self._append_token(req, tok)
             self._maybe_finish(req)
 
     def _maybe_finish(self, req: Request) -> None:
@@ -311,6 +371,16 @@ class RequestManager:
             if req.slot >= 0:
                 self.slots[req.slot] = None
                 req.slot = -1
+            tel = self.telemetry
+            if tel.enabled:
+                ts = self._tstamps.get(req.rid, {})
+                now = tel.now()
+                first = ts.get("first_token")
+                tel.request_finished(
+                    req.trace_id, n_tokens=len(req.generated),
+                    tpot_s=((now - first)
+                            / max(len(req.generated) - 1, 1)
+                            if first is not None else None))
 
     # ------------------------------------------------------------------
     def _scan_steps_possible(self) -> int:
@@ -428,8 +498,8 @@ class RequestManager:
             start = max(s for s in starts if s <= chunk_idx)
             req = self.requests[rid]
             req.status = RequestStatus.DECODING
-            req.generated.append(int(toks[start][chunk_idx - start, flat_idx]))
-            self.tokens_decoded += 1
+            self._append_token(req,
+                               int(toks[start][chunk_idx - start, flat_idx]))
             self._maybe_finish(req)
         self.steps += len(chunks)
         self.scan_runs += 1
@@ -462,8 +532,7 @@ class RequestManager:
                 req = self.requests[rid]
                 if req.status is not RequestStatus.DECODING or not live[s, flat]:
                     continue
-                req.generated.append(int(toks[s, flat]))
-                self.tokens_decoded += 1
+                self._append_token(req, int(toks[s, flat]))
                 self._maybe_finish(req)
         self.steps += n
         self.scan_runs += 1
@@ -483,10 +552,17 @@ class RequestManager:
         returns once every arrival is in).
 
         Returns ``{rid: record}`` with ``arrival_s``, ``first_token_s``
-        (host-visible TTFT stamp), ``finish_s``, ``prompt_len`` and
-        ``tokens`` — per-request outputs are INVARIANT to arrival timing
-        (continuous batching only reorders work, never results), pinned by
-        tests/test_serving_under_load.py.
+        (host-visible TTFT stamp), ``finish_s``, ``prompt_len``,
+        ``trace_id``, ``tokens``, and the TTFT decomposition
+        ``queue_wait_s`` / ``prefill_s``: ``prefill_start_s`` is stamped at
+        the start of the step in which the request's FIRST prompt token was
+        fed to the device, so queue wait (arrival -> prefill actually
+        starting: pending queue + slot wait + tiled-budget starvation) is
+        reported separately from prefill compute (``queue_wait_s +
+        prefill_s == first_token_s - arrival_s``).  All stamps are
+        host-visible at step-boundary granularity.  Per-request outputs are
+        INVARIANT to arrival timing (continuous batching only reorders
+        work, never results), pinned by tests/test_serving_under_load.py.
         """
         import time as _time
 
@@ -495,6 +571,7 @@ class RequestManager:
         pending = sorted(arrivals, key=lambda a: a[0])
         records: Dict[int, Dict] = {}
         saved_chunk = self.scan_chunk
+        tel = self.telemetry
 
         def admit_due():
             now = clock() - t0
@@ -502,8 +579,17 @@ class RequestManager:
                 off, prompt, mnt = pending.pop(0)
                 rid = self.register_new_request(prompt, mnt)
                 records[rid] = {"arrival_s": off, "admitted_s": now,
-                                "prompt_len": len(prompt)}
+                                "prompt_len": len(prompt),
+                                "trace_id": self.requests[rid].trace_id}
             return clock() - t0
+
+        def prefill_starters():
+            # requests whose first prompt token may enter the device in the
+            # NEXT step: stamped with the step's start time if it does
+            # (admission itself can also happen inside the step)
+            return [rid for rid, rec in records.items()
+                    if "prefill_start_s" not in rec
+                    and self.requests[rid].prefill_offset == 0]
 
         def stamp(now):
             for rid, rec in records.items():
@@ -527,22 +613,38 @@ class RequestManager:
                                                   pending[0][0] - now)))
                     continue
                 self.scan_chunk = quantum if pending else saved_chunk
+                starters = prefill_starters()
                 if self._prefill_stretch_possible():
-                    self._prefill_stretch()
+                    with tel.span("prefill_stretch", cat="serve"):
+                        self._prefill_stretch()
                 else:
                     n = self._scan_steps_possible()
                     if n > 1:
-                        self._decode_stretch(n)
+                        with tel.span("decode_stretch", cat="serve",
+                                      steps=n):
+                            self._decode_stretch(n)
                     else:
-                        bc, sample_points = self.prepare_next_batch()
-                        result = self.im.step(bc, sample=self._sample_arg())
-                        self.process_result(result, sample_points)
-                        self.steps += 1
+                        with tel.span("serve_step", cat="serve"):
+                            bc, sample_points = self.prepare_next_batch()
+                            result = self.im.step(bc,
+                                                  sample=self._sample_arg())
+                            self.process_result(result, sample_points)
+                            self.steps += 1
+                for rid in starters:
+                    if self.requests[rid].prefill_offset > 0:
+                        records[rid]["prefill_start_s"] = now
+                        if tel.enabled:
+                            tel.request_prefill_started(
+                                self.requests[rid].trace_id)
                 stamp(clock() - t0)
         finally:
             self.scan_chunk = saved_chunk
         for rid, rec in records.items():
             rec["tokens"] = self.requests[rid].generated
+            start = rec.get("prefill_start_s", rec.get("admitted_s"))
+            if "first_token_s" in rec and start is not None:
+                rec["queue_wait_s"] = start - rec["arrival_s"]
+                rec["prefill_s"] = rec["first_token_s"] - start
         return records
 
     def serve_incr_decoding(self) -> Dict[int, List[int]]:
@@ -553,18 +655,22 @@ class RequestManager:
         the ~100ms tunnel sync amortizes over up to ``scan_chunk`` tokens;
         the per-step host path only handles admission/prefill boundaries.
         """
+        tel = self.telemetry
         while self.has_work():
             if self._prefill_stretch_possible():
-                self._prefill_stretch()
+                with tel.span("prefill_stretch", cat="serve"):
+                    self._prefill_stretch()
                 continue
             n = self._scan_steps_possible()
             if n > 1:
-                self._decode_stretch(n)
+                with tel.span("decode_stretch", cat="serve", steps=n):
+                    self._decode_stretch(n)
                 continue
-            bc, sample_points = self.prepare_next_batch()
-            result = self.im.step(bc, sample=self._sample_arg())
-            self.process_result(result, sample_points)
-            self.steps += 1
+            with tel.span("serve_step", cat="serve"):
+                bc, sample_points = self.prepare_next_batch()
+                result = self.im.step(bc, sample=self._sample_arg())
+                self.process_result(result, sample_points)
+                self.steps += 1
         return {rid: r.generated for rid, r in self.requests.items()}
 
     _serve = serve_incr_decoding  # overridden by SpecInferManager

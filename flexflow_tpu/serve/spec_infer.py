@@ -154,8 +154,7 @@ class SpecInferManager(RequestManager):
                 req = self.requests[rid]
                 req.status = RequestStatus.DECODING
                 req.llm_committed = len(req.prompt)
-                req.generated.append(int(ids[flat]))
-                self.tokens_decoded += 1
+                self._append_token(req, int(ids[flat]))
                 self._maybe_finish(req)
 
         # SSM prefill (prompt) + catch-up (tokens accepted by previous rounds)
@@ -373,8 +372,7 @@ class SpecInferManager(RequestManager):
                 (t, base_pos + k) for k, t in enumerate(acc_toks)
             ]
             for t in new_tokens:
-                req.generated.append(t)
-                self.tokens_decoded += 1
+                self._append_token(req, t)
                 self._maybe_finish(req)
                 if req.status is RequestStatus.COMPLETED:
                     break

@@ -4,25 +4,56 @@ Reference: the reference's ``--profiling`` flag + Legion's runtime tracing
 (SURVEY.md §5).  The TPU-native equivalent is an XLA/TPU trace captured with
 ``jax.profiler`` (viewable in XProf/TensorBoard or Perfetto); training and
 serving entry points wrap their loops in :func:`maybe_profile`.
+
+Every profiled run gets its OWN timestamped directory under ``TRACE_DIR``
+(:func:`run_trace_dir`) — repeated runs used to overwrite
+``artifacts/profile`` silently, losing the before/after pair exactly when a
+perf comparison needed it.  The serving telemetry layer (``obs/``) exports
+its host-side trace/metrics JSON into the same run dir when both are
+enabled (``examples/serve_llama.py --profile``), so one directory holds the
+device-side XProf view and the request-side telemetry view of a run.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import time
 
 TRACE_DIR = os.path.join("artifacts", "profile")
 
 
+def run_trace_dir(base: str = None, stamp: str = None) -> str:
+    """Create and return a fresh per-run trace dir:
+    ``<base>/<YYYYmmdd-HHMMSS>-p<pid>[-<k>]`` — the pid disambiguates
+    concurrent processes, the ``-<k>`` suffix same-second runs in one
+    process.  Never reuses an existing directory (no silent overwrite)."""
+    base = base or TRACE_DIR
+    stamp = stamp or time.strftime("%Y%m%d-%H%M%S")
+    root = os.path.join(base, f"{stamp}-p{os.getpid()}")
+    cand, k = root, 0
+    while os.path.exists(cand):
+        k += 1
+        cand = f"{root}-{k}"
+    os.makedirs(cand)
+    return cand
+
+
 @contextlib.contextmanager
 def maybe_profile(enabled: bool, trace_dir: str = None):
-    """Capture a jax.profiler trace around the body when ``enabled``."""
+    """Capture a jax.profiler trace around the body when ``enabled``.
+
+    ``trace_dir``: explicit destination; default is a fresh
+    :func:`run_trace_dir` per call.  Yields the directory in use (None
+    when disabled) so callers can drop companion artifacts next to the
+    XProf files.
+    """
     if not enabled:
         yield None
         return
     import jax
 
-    trace_dir = trace_dir or TRACE_DIR
+    trace_dir = trace_dir or run_trace_dir()
     os.makedirs(trace_dir, exist_ok=True)
     jax.profiler.start_trace(trace_dir)
     try:

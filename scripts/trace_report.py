@@ -1,0 +1,42 @@
+"""Summarize a serving-telemetry JSONL export.
+
+Usage::
+
+    python scripts/trace_report.py artifacts/telemetry/serve.jsonl
+
+Prints one JSON document: request counts, p50/p95 TTFT / TPOT /
+queue-wait (derived from the request-lifecycle events), per-track span
+totals (pipeline stage interleave), the pp bubble fraction, and the
+per-plan predicted-vs-measured error table from the calibration ledger.
+
+The reduction itself lives in :mod:`flexflow_tpu.obs.report`
+(``summarize_jsonl``) so ``bench.py --dry-run``'s observability section and
+this CLI can never disagree — a tier-1 test round-trips one through the
+other (tests/test_trace_report.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a flexflow_tpu telemetry JSONL")
+    ap.add_argument("jsonl", help="path to a Telemetry.export *.jsonl")
+    ap.add_argument("--indent", type=int, default=None,
+                    help="pretty-print with this JSON indent")
+    args = ap.parse_args(argv)
+
+    from flexflow_tpu.obs.report import summarize_jsonl
+
+    print(json.dumps(summarize_jsonl(args.jsonl), indent=args.indent))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

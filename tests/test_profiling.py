@@ -23,3 +23,25 @@ def test_fit_writes_trace(tmp_path, monkeypatch):
               epochs=1, batch_size=8, verbose=0)
     traces = glob.glob(str(tmp_path / "profile" / "**" / "*"), recursive=True)
     assert any(os.path.isfile(t) for t in traces), "no trace files written"
+
+
+def test_run_trace_dirs_never_collide(tmp_path):
+    # per-run timestamped dirs: repeated runs (same second, same pid) must
+    # land in DISTINCT directories — no silent overwrite of a prior trace
+    base = str(tmp_path / "profile")
+    dirs = [profiling.run_trace_dir(base=base, stamp="20260803-000000")
+            for _ in range(3)]
+    assert len(set(dirs)) == 3
+    for d in dirs:
+        assert os.path.isdir(d)
+        assert d.startswith(os.path.join(base, "20260803-000000"))
+
+
+def test_maybe_profile_defaults_to_fresh_run_dir(tmp_path, monkeypatch):
+    monkeypatch.setattr(profiling, "TRACE_DIR", str(tmp_path / "profile"))
+    with profiling.maybe_profile(True) as d1:
+        pass
+    with profiling.maybe_profile(True) as d2:
+        pass
+    assert d1 != d2 and os.path.isdir(d1) and os.path.isdir(d2)
+    assert not profiling.maybe_profile(False).__enter__()

@@ -75,10 +75,21 @@ def test_arrival_records_are_complete_and_ordered():
         assert rec["arrival_s"] <= rec["admitted_s"]
         assert rec["admitted_s"] < rec["first_token_s"] <= rec["finish_s"]
         assert len(rec["tokens"]) == 4
+        # TTFT decomposition (the queue-wait/prefill split): queue wait
+        # ends at the start of the step that fed the first prompt token
+        assert rec["trace_id"]
+        ttft = rec["first_token_s"] - rec["arrival_s"]
+        assert abs(rec["queue_wait_s"] + rec["prefill_s"] - ttft) < 1e-9
+        assert 0.0 <= rec["queue_wait_s"] <= ttft
+        assert rec["prefill_s"] >= 0.0
+        assert rec["prefill_start_s"] >= rec["arrival_s"]
     # queueing visible: with 2 slots and 5 near-simultaneous arrivals,
     # later requests admit strictly later than the first two
     admits = sorted(r["admitted_s"] for r in records.values())
     assert admits[-1] > admits[0]
+    # and the slot-starved requests' queue wait dominates the early ones'
+    qw = [r["queue_wait_s"] for r in records.values()]
+    assert max(qw) > min(qw)
 
 
 def test_arrival_scan_quantum_restored():
@@ -105,3 +116,11 @@ def test_under_load_metrics_helper():
     assert m["ttft_p50_ms"] <= m["ttft_p95_ms"]
     assert m["tpot_p50_ms"] <= m["tpot_p95_ms"]
     assert m["goodput_tokens_per_sec"] > 0
+    # the reduction now lives in the obs layer (one accounting for bench,
+    # tests, and trace_report) and splits TTFT into queue wait + prefill
+    from flexflow_tpu.obs.report import under_load_summary
+
+    assert m == under_load_summary(records)
+    assert m["queue_wait_p50_ms"] is not None
+    assert m["queue_wait_p50_ms"] <= m["ttft_p50_ms"]
+    assert m["prefill_p50_ms"] is not None
