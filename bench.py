@@ -1129,6 +1129,7 @@ def observability_dryrun(out_dir=None):
 
     from flexflow_tpu.obs import Telemetry
     from flexflow_tpu.obs.report import summarize_jsonl
+    from flexflow_tpu.obs.telemetry import RESILIENCE_COUNTERS
 
     class _Tick:  # deterministic virtual clock: 1ms per reading
         t = 0.0
@@ -1174,14 +1175,45 @@ def observability_dryrun(out_dir=None):
                                transfer_ms=0.02, memory_gb=3.1)
     tel.record_plan_measured("tp1_pp2_m2", tpot_ms=7.7, memory_gb=3.0)
 
+    # ---- serving_resilience: the robustness lifecycle/counters the
+    # resilient-serving layer (serve/resilience.py) emits, through the same
+    # real Telemetry APIs so trace_report round-trips them: one rejected
+    # arrival (admission control), one preempt->recompute->finish, one
+    # cancelled request, and a retried dispatch fault
+    t0 = tel.request_enqueued("r00006", prompt_len=48)
+    tel.request_rejected("r00006", reason="pending queue full (4 >= 4)")
+    t0 = tel.request_enqueued("r00007", prompt_len=40)
+    tel.request_admitted("r00007", queue_wait_s=tel.now() - t0)
+    tel.request_prefill_started("r00007")
+    tel.request_first_token("r00007", ttft_s=tel.now() - t0)
+    first = tel.now()
+    tel.request_preempted("r00007", recompute_tokens=43)
+    # readmission re-prefills prompt+generated, then decoding resumes
+    tel.request_finished("r00007", n_tokens=5, tpot_s=(tel.now() - first) / 4)
+    t0 = tel.request_enqueued("r00008", prompt_len=16)
+    tel.request_admitted("r00008", queue_wait_s=tel.now() - t0)
+    tel.request_cancelled("r00008", n_tokens=0)
+    tel.fault_observed("stage1_hop", detail="injected fault #1 at stage1_hop")
+    tel.dispatch_retry("stage1_hop", attempt=1, backoff_s=0.01)
+
     out_dir = out_dir or os.path.join("artifacts", "telemetry")
     paths = tel.export(out_dir, prefix="dryrun")
+    snap = tel.metrics.snapshot()
     return {
         "observability": {
             "paths": paths,
             "summary": summarize_jsonl(paths["jsonl"]),
-            "metrics": tel.metrics.snapshot(),
+            "metrics": snap,
             "calibration": tel.calibration.report(),
+            "serving_resilience": {
+                "counters": {k: snap.get(k)
+                             for k in RESILIENCE_COUNTERS if k in snap},
+                "note": "reject/preempt/cancel/retry flow through the "
+                        "shared Telemetry.request_*/dispatch_* schema; "
+                        "real chaos runs (tests/test_resilience.py) attach "
+                        "a seeded FaultInjector and export the same "
+                        "counters",
+            },
             "note": "synthetic virtual-clock session through the real "
                     "telemetry APIs (schema fidelity, no device); real "
                     "serve sections attach Telemetry to their "

@@ -25,6 +25,16 @@ _ADMIT = "request_admit"
 _PREFILL = "request_prefill_start"
 _FIRST = "request_first_token"
 _FINISH = "request_finish"
+# resilient-serving lifecycle (terminal outcomes + dispatch events)
+_TERMINAL_EVENTS = {
+    "request_reject": "rejected",
+    "request_cancel": "cancelled",
+    "request_timeout": "timeout",
+    "request_fail": "failed",
+}
+_PREEMPT = "request_preempt"
+_RETRY = "dispatch_retry"
+_FAULT = "dispatch_fault"
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -44,6 +54,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     reqs: Dict[str, Dict] = {}
     track_spans: Dict[int, float] = {}
     track_names: Dict[int, str] = {}
+    outcomes: Dict[str, int] = {}
+    preemptions = retries = faults = 0
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -54,16 +66,28 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             track_spans[tid] = track_spans.get(tid, 0.0) \
                 + ev.get("dur", 0.0) / 1e6
             continue
+        name = ev.get("name")
+        if name == _RETRY:
+            retries += 1
+            continue
+        if name == _FAULT:
+            faults += 1
+            continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
         if trace_id is None:
             continue
         rec = reqs.setdefault(trace_id, {})
-        name = ev.get("name")
         if name in (_ENQ, _ADMIT, _PREFILL, _FIRST, _FINISH):
             rec[name] = ev.get("ts", 0.0) / 1e6  # -> seconds
             if name == _FINISH:
                 rec["n_tokens"] = args.get("n_tokens", 0)
+        elif name in _TERMINAL_EVENTS:
+            out = _TERMINAL_EVENTS[name]
+            rec["outcome"] = out
+            outcomes[out] = outcomes.get(out, 0) + 1
+        elif name == _PREEMPT:
+            preemptions += 1
 
     ttft, tpot, queue_wait, prefill = [], [], [], []
     completed = 0
@@ -71,6 +95,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         enq = rec.get(_ENQ)
         first = rec.get(_FIRST)
         fin = rec.get(_FINISH)
+        if fin is not None:
+            outcomes["ok"] = outcomes.get("ok", 0) + 1
         if enq is not None and first is not None:
             ttft.append(first - enq)
             # queue wait ends where prefill begins (fall back to admission
@@ -99,6 +125,11 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         "tpot_p50_ms": _pct_ms(tpot, 0.50),
         "tpot_p95_ms": _pct_ms(tpot, 0.95),
         "span_ms_by_track": spans_by_track,
+        # resilient serving: terminal-outcome mix + recovery activity
+        "outcomes": outcomes,
+        "preemptions": preemptions,
+        "dispatch_retries": retries,
+        "dispatch_faults": faults,
     }
 
 
@@ -129,6 +160,12 @@ def summarize_jsonl(path: str) -> Dict:
     summary["events"] = meta.get("events", len(events))
     summary["dropped"] = meta.get("dropped", 0)
     summary["bubble_frac"] = metrics.get("pp_bubble_frac")
+    # registry view of the resilience counters (the trace ring can drop
+    # events under pressure; the counters are exact)
+    from .telemetry import RESILIENCE_COUNTERS
+
+    summary["robustness"] = {
+        k: metrics[k] for k in RESILIENCE_COUNTERS if k in metrics}
 
     pred_err: Dict[str, Dict] = {}
     for plan, fields in calibration.get("plans", {}).items():
@@ -150,11 +187,19 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None
     p50/p95, goodput.  Pure host-side math — the hermetic small-shape test
     (tests/test_serving_under_load.py) runs it on a virtual clock."""
     recs = list(records.values())
-    done = [r for r in recs if "finish_s" in r]
+    outcomes: Dict[str, int] = {}
+    for r in recs:
+        out = r.get("outcome", "ok")
+        outcomes[out] = outcomes.get(out, 0) + 1
+    # "completed" = ok finishes only; cancelled/timed-out/rejected/failed
+    # requests are terminal but not completions
+    done = [r for r in recs
+            if "finish_s" in r and r.get("outcome", "ok") == "ok"]
     ttft = [r["first_token_s"] - r["arrival_s"]
             for r in recs if "first_token_s" in r]
     tpot = [(r["finish_s"] - r["first_token_s"])
-            / max(len(r["tokens"]) - 1, 1) for r in done]
+            / max(len(r["tokens"]) - 1, 1)
+            for r in done if "first_token_s" in r]
     queue_wait = [r["queue_wait_s"] for r in recs if "queue_wait_s" in r]
     prefill = [r["prefill_s"] for r in recs if "prefill_s" in r]
 
@@ -176,4 +221,5 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None
         "tpot_p95_ms": _pct_ms(tpot, 0.95),
         "goodput_tokens_per_sec": (round(total_tokens / makespan, 1)
                                    if makespan else None),
+        "outcomes": outcomes,
     }

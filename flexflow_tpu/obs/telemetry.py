@@ -39,6 +39,16 @@ from .calibration import CalibrationLedger
 from .metrics import MetricsRegistry
 from .trace import TraceRecorder
 
+# the resilience counter vocabulary (emitted by the request_rejected/
+# cancelled/timed_out/preempted/failed + dispatch_retry/fault_observed
+# methods below) — report.summarize_jsonl and bench's dry-run section both
+# import THIS tuple, so a renamed counter cannot silently drop from either
+RESILIENCE_COUNTERS = (
+    "requests_rejected", "requests_cancelled", "requests_timeout",
+    "requests_preempted", "requests_failed", "recompute_tokens",
+    "dispatch_retries", "dispatch_faults",
+)
+
 
 class Telemetry:
     enabled = True
@@ -103,6 +113,52 @@ class Telemetry:
         return self.trace.instant("request_finish", "request", "requests",
                                   trace_id=trace_id, n_tokens=n_tokens,
                                   tpot_s=tpot_s)
+
+    # ---- resilient serving (serve/resilience.py) ----------------------
+    def request_rejected(self, trace_id: str, reason: str = "") -> float:
+        """Admission control refused the request (bounded queue / KV
+        headroom / invalid shape) — an explicit terminal outcome."""
+        self.metrics.counter("requests_rejected").inc()
+        return self.trace.instant("request_reject", "request", "requests",
+                                  trace_id=trace_id, reason=reason)
+
+    def request_cancelled(self, trace_id: str, n_tokens: int = 0) -> float:
+        self.metrics.counter("requests_cancelled").inc()
+        return self.trace.instant("request_cancel", "request", "requests",
+                                  trace_id=trace_id, n_tokens=n_tokens)
+
+    def request_timed_out(self, trace_id: str, n_tokens: int = 0) -> float:
+        self.metrics.counter("requests_timeout").inc()
+        return self.trace.instant("request_timeout", "request", "requests",
+                                  trace_id=trace_id, n_tokens=n_tokens)
+
+    def request_preempted(self, trace_id: str,
+                          recompute_tokens: int = 0) -> float:
+        """Slot/KV-pressure eviction; ``recompute_tokens`` is the
+        prompt+generated length the readmission will re-prefill."""
+        self.metrics.counter("requests_preempted").inc()
+        self.metrics.counter("recompute_tokens").inc(recompute_tokens)
+        return self.trace.instant("request_preempt", "request", "requests",
+                                  trace_id=trace_id,
+                                  recompute_tokens=recompute_tokens)
+
+    def request_failed(self, trace_id: str, site: str = "") -> float:
+        self.metrics.counter("requests_failed").inc()
+        return self.trace.instant("request_fail", "request", "requests",
+                                  trace_id=trace_id, site=site)
+
+    def dispatch_retry(self, site: str, attempt: int = 1,
+                       backoff_s: float = 0.0) -> float:
+        self.metrics.counter("dispatch_retries").inc()
+        return self.trace.instant("dispatch_retry", "dispatch", "dispatch",
+                                  site=site, attempt=attempt,
+                                  backoff_s=backoff_s)
+
+    def fault_observed(self, site: str, detail: str = "") -> float:
+        """A transient dispatch/hop fault was caught (injected or real)."""
+        self.metrics.counter("dispatch_faults").inc()
+        return self.trace.instant("dispatch_fault", "dispatch", "dispatch",
+                                  site=site, detail=detail)
 
     def batch_composition(self, decode_tokens: int, prefill_tokens: int,
                           active_requests: int, max_requests: int,
@@ -206,6 +262,27 @@ class NullTelemetry:
         return 0.0
 
     def request_finished(self, *a, **k):
+        return 0.0
+
+    def request_rejected(self, *a, **k):
+        return 0.0
+
+    def request_cancelled(self, *a, **k):
+        return 0.0
+
+    def request_timed_out(self, *a, **k):
+        return 0.0
+
+    def request_preempted(self, *a, **k):
+        return 0.0
+
+    def request_failed(self, *a, **k):
+        return 0.0
+
+    def dispatch_retry(self, *a, **k):
+        return 0.0
+
+    def fault_observed(self, *a, **k):
         return 0.0
 
     def batch_composition(self, *a, **k):

@@ -35,6 +35,14 @@ from .request_manager import (
     Request,
     RequestManager,
     RequestStatus,
+    TERMINAL_STATUSES,
+)
+from .resilience import (
+    FaultInjector,
+    InjectedFault,
+    ResilienceConfig,
+    RetryPolicy,
+    TransientServeError,
 )
 from .spec_infer import SpecInferManager
 from .api import LLM, SSM
@@ -58,7 +66,13 @@ __all__ = [
     "RequestManager",
     "Request",
     "RequestStatus",
+    "TERMINAL_STATUSES",
     "GenerationConfig",
+    "ResilienceConfig",
+    "RetryPolicy",
+    "FaultInjector",
+    "InjectedFault",
+    "TransientServeError",
     "SpecInferManager",
     "LLM",
     "SSM",

@@ -66,12 +66,21 @@ class LLM:
         dtype=None,
         devices=None,
         kv_dtype=None,
+        telemetry=None,
+        resilience=None,
+        fault_injector=None,
     ) -> "LLM":
         """``kv_dtype="int8"`` stores the KV caches int8 with fused
         in-kernel dequant (see ``InferenceManager``) — halves decode KV
         bandwidth and doubles context/batch capacity per HBM byte, which is
         what makes the full-depth Llama-2-7B shape (int8 weights via
-        ``quantize_int8`` + int8 KV) admissible on one 16 GB chip."""
+        ``quantize_int8`` + int8 KV) admissible on one 16 GB chip.
+
+        ``telemetry`` / ``resilience`` / ``fault_injector`` thread the
+        observability handle and the resilient-serving policy layer
+        (admission control, deadlines/cancellation, preemption-and-
+        recompute, dispatch retry — see ``serve/resilience.py``) into the
+        RequestManager."""
         devices = devices if devices is not None else jax.devices()[:tp]
         mesh = make_mesh({"tp": tp}, devices)
         ff = FFModel(FFConfig(), mesh=mesh)
@@ -112,10 +121,14 @@ class LLM:
                     kv_dtype=kv_dtype,
                 )
             self.rm = SpecInferManager(
-                self.im, ssm.im, gen, width=spec_width, depth=spec_depth
+                self.im, ssm.im, gen, width=spec_width, depth=spec_depth,
+                telemetry=telemetry, resilience=resilience,
+                fault_injector=fault_injector,
             )
         else:
-            self.rm = RequestManager(self.im, gen)
+            self.rm = RequestManager(self.im, gen, telemetry=telemetry,
+                                     resilience=resilience,
+                                     fault_injector=fault_injector)
         return self
 
     # ------------------------------------------------------------------

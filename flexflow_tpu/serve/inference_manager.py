@@ -226,8 +226,16 @@ def sample_tokens(logits, sample):
     ``src/ops/sampling.cu``) but with DYNAMIC temperature/top_p (traced
     scalars, so one compiled step serves every GenerationConfig) and an
     explicit key threaded from the RequestManager.
+
+    ``sample`` is ``(key, temperature, top_p)`` — one key draws every row —
+    or the resilient-serving 4-tuple ``(key, temperature, top_p, folds)``
+    with ``folds`` i32[rows, 2]: row ``i`` draws from
+    ``fold_in(fold_in(key, folds[i, 0]), folds[i, 1])``, i.e. a PER-REQUEST
+    (rid, token-index) key schedule that is invariant to batch composition
+    and preemption-and-recompute (see RequestManager._sample_for).
     """
-    key, temperature, top_p = sample
+    key, temperature, top_p = sample[:3]
+    folds = sample[3] if len(sample) > 3 else None
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def draw(_):
@@ -238,7 +246,12 @@ def sample_tokens(logits, sample):
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_lg, cutoff_idx, axis=-1)
         lg = jnp.where(lg < cutoff, -jnp.inf, lg)
-        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+        if folds is None:
+            return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+        keys = jax.vmap(
+            lambda f: jax.random.fold_in(jax.random.fold_in(key, f[0]), f[1])
+        )(folds)
+        return jax.vmap(jax.random.categorical)(keys, lg).astype(jnp.int32)
 
     return jax.lax.cond(temperature <= 0.0, lambda _: greedy, draw, None)
 
@@ -249,6 +262,11 @@ class InferenceManager:
     # cannot change compiled executables or their outputs.  RequestManager
     # shares its handle here; the class default is the no-op singleton.
     telemetry = NULL_TELEMETRY
+    # seeded chaos hook (serve/resilience.py), synced by the RequestManager
+    # like the telemetry handle.  Consulted at each dispatch site BEFORE
+    # any work reaches the device, so an injected fault leaves no partial
+    # device state and a retried dispatch replays identical compute.
+    fault_injector = None
 
     def __init__(
         self,
@@ -508,6 +526,8 @@ class InferenceManager:
         ``sample``: optional ``(key, temperature, top_p)`` — argmax if None.
         """
         assert self.params is not None, "call init_operators_inference() first"
+        if self.fault_injector is not None:
+            self.fault_injector.maybe_fail("step")
         # span = host dispatch time (the jit call returns without syncing);
         # device time shows up at the result readback, not here.  Dispatch
         # spans live on their own track: they nest inside the serve loop's
@@ -538,8 +558,14 @@ class InferenceManager:
             state, bc, alive = carry
             stp = None
             if sample is not None:
-                key, temperature, top_p = sample
-                stp = (jax.random.fold_in(key, i), temperature, top_p)
+                if len(sample) > 3:
+                    # per-request key schedule: each row's token index
+                    # advances one per scan step
+                    key, temperature, top_p, folds = sample
+                    stp = (key, temperature, top_p, folds.at[:, 1].add(i))
+                else:
+                    key, temperature, top_p = sample
+                    stp = (jax.random.fold_in(key, i), temperature, top_p)
             result, state = self._step_impl(params, state, bc, stp)
             toks = result.token_ids
             live = alive  # emission validity for THIS step
@@ -595,6 +621,8 @@ class InferenceManager:
                 f"{self.max_seq_len}; cache writes past the end clamp to the "
                 "last slot and silently corrupt it"
             )
+        if self.fault_injector is not None:
+            self.fault_injector.maybe_fail("decode_scan")
         with self.telemetry.span("decode_scan_dispatch", cat="dispatch",
                                  track="dispatch", n_steps=n_steps):
             tokens, live, self.state, bc = self._scan(
@@ -669,9 +697,17 @@ class InferenceManager:
         XLA's scheduler refuses the overlap the ablation delta is ~0 and
         the artifact records it as scheduler-bound.
         """
-        def run_step(state, bc, i, qkv0=None):
+        # per-request (rid, token-index) sample keys ride the scan xs with
+        # a leading chunk axis (the 4-tuple schedule — see sample_tokens);
+        # the legacy 3-tuple folds the shared key by chunk index instead
+        per_row = sample is not None and len(sample) > 3
+        folds_all = sample[3] if per_row else None
+
+        def run_step(state, bc, i, fold=None, qkv0=None):
             stp = None
-            if sample is not None:
+            if per_row:
+                stp = (sample[0], sample[1], sample[2], fold)
+            elif sample is not None:
                 key, temperature, top_p = sample
                 stp = (jax.random.fold_in(key, i), temperature, top_p)
             return self._step_impl(params, state, bc, stp, qkv0=qkv0)
@@ -679,12 +715,15 @@ class InferenceManager:
         n = bcs.base.tokens.shape[0]
         idx = jnp.arange(n)
         if not overlap:
-            def body(state, bc_i):
-                bc, i = bc_i
-                result, state = run_step(state, bc, i)
+            def body(state, xs):
+                bc, i = xs[0], xs[1]
+                result, state = run_step(state, bc, i,
+                                         xs[2] if per_row else None)
                 return state, result.token_ids
 
-            state, tokens = jax.lax.scan(body, state, (bcs, idx))
+            state, tokens = jax.lax.scan(
+                body, state,
+                (bcs, idx, folds_all) if per_row else (bcs, idx))
             return tokens, state  # tokens: i32[n_chunks, T or R]
 
         # chunk i+1's batch config rides step i's xs; the final step
@@ -696,13 +735,16 @@ class InferenceManager:
 
         def body(carry, xs):
             state, pre = carry
-            bc, bc_next, i = xs
-            result, state = run_step(state, bc, i, qkv0=pre)
+            bc, bc_next, i = xs[0], xs[1], xs[2]
+            result, state = run_step(state, bc, i,
+                                     xs[3] if per_row else None, qkv0=pre)
             pre_next = self._project_chunk0(params, bc_next)
             return (state, pre_next), result.token_ids
 
         (state, _), tokens = jax.lax.scan(
-            body, (state, pre0), (bcs, bcs_next, idx))
+            body, (state, pre0),
+            (bcs, bcs_next, idx, folds_all) if per_row
+            else (bcs, bcs_next, idx))
         return tokens, state
 
     def prefill_scan(self, bcs, sample=None):
@@ -712,6 +754,8 @@ class InferenceManager:
         carrying a prompt's final position emit a SAMPLED first token.
         """
         assert self.params is not None, "call init_operators_inference() first"
+        if self.fault_injector is not None:
+            self.fault_injector.maybe_fail("prefill_scan")
         with self.telemetry.span("prefill_scan_dispatch", cat="dispatch",
                                  track="dispatch",
                                  n_chunks=int(bcs.base.tokens.shape[0])):

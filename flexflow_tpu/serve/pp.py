@@ -206,6 +206,12 @@ class PipelinedInferenceManager:
     # dispatches land on per-stage trace tracks ("stage0", "stage1", ...)
     # so a Perfetto export shows the micro-batch interleave per stage
     telemetry = NULL_TELEMETRY
+    # seeded chaos hook (serve/resilience.py), synced by the RequestManager.
+    # Consulted before every stage dispatch AND every inter-stage hop —
+    # faults raise before device work, and retrying a whole macro-step is
+    # safe because stage KV writes are positional and value-deterministic
+    # (a replayed micro-batch rewrites identical values; see _dispatch).
+    fault_injector = None
 
     def __init__(
         self,
@@ -453,14 +459,19 @@ class PipelinedInferenceManager:
         on the receiving stage's track.
         """
         tel = self.telemetry
+        fi = self.fault_injector
         xs: Tuple = ()
         res = None
         n = len(self.stages)
         for s, stage in enumerate(self.stages):
             with tel.span("stage_dispatch", cat="pp", track=f"stage{s}",
                           stage=s, mb=mb):
+                if fi is not None:
+                    fi.maybe_fail(f"stage{s}_dispatch")
                 bc_s = jax.device_put(bc, stage.replicated)
                 if s > 0:
+                    if fi is not None:
+                        fi.maybe_fail(f"stage{s}_hop")
                     tel.instant("stage_hop", cat="pp", track=f"stage{s}",
                                 stage=s, mb=mb)
                     if tel.enabled:
@@ -507,13 +518,22 @@ class PipelinedInferenceManager:
         with tel.span("pp_macro_step", cat="pp", track="pp",
                       n_micro=len(mbs)):
             results = []
+            k = self.max_tokens // max(len(mbs), 1)
             for j, mbc in enumerate(mbs):
                 smp = sample
                 if sample is not None and len(mbs) > 1:
-                    # per-micro-batch key: same sampling distribution as the
-                    # single-program step, different bitstream (documented)
-                    key, t, p = sample
-                    smp = (jax.random.fold_in(key, j), t, p)
+                    if len(sample) > 3:
+                        # per-request (rid, token-index) keys: slice the
+                        # fold rows to this micro-batch's contiguous token
+                        # range — sampled output is then bit-identical to
+                        # the single-program step (rows and keys align)
+                        key, t, p, folds = sample
+                        smp = (key, t, p, folds[j * k: (j + 1) * k])
+                    else:
+                        # per-micro-batch key: same sampling distribution
+                        # as the single-program step, different bitstream
+                        key, t, p = sample
+                        smp = (jax.random.fold_in(key, j), t, p)
                 results.append(self._dispatch(mbc, smp, mb=j))
         return self._merge_results(results)
 
@@ -569,8 +589,15 @@ class PipelinedInferenceManager:
                 for j in range(m):
                     smp = None
                     if sample is not None:
-                        key, t, p = sample
-                        smp = (jax.random.fold_in(key, i * m + j), t, p)
+                        if len(sample) > 3:
+                            key, t, p, folds = sample
+                            k = folds.shape[0] // m
+                            f = folds[j * k: (j + 1) * k]
+                            smp = (key, t, p,
+                                   f + jnp.array([0, i], jnp.int32))
+                        else:
+                            key, t, p = sample
+                            smp = (jax.random.fold_in(key, i * m + j), t, p)
                     res = self._dispatch(mbs[j], smp, mb=j)
                     mbs[j], alive[j], live = self._advance(
                         mbs[j], res.token_ids, alive[j], eos=eos)

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..obs.telemetry import telemetry_or_null
 from .batch_config import BatchConfig, PrefillBatchConfig
+from .resilience import ResilienceConfig, TransientServeError
 
 
 class RequestStatus(enum.Enum):
@@ -28,6 +29,29 @@ class RequestStatus(enum.Enum):
     PREFILLING = 1
     DECODING = 2
     COMPLETED = 3
+    # resilient-serving lifecycle (serve/resilience.py): PREEMPTED requests
+    # sit back in the pending queue and recompute prompt+generated on
+    # readmission; the rest are terminal.
+    PREEMPTED = 4
+    CANCELLED = 5
+    TIMED_OUT = 6
+    REJECTED = 7
+    FAILED = 8
+
+
+TERMINAL_STATUSES = frozenset({
+    RequestStatus.COMPLETED, RequestStatus.CANCELLED,
+    RequestStatus.TIMED_OUT, RequestStatus.REJECTED, RequestStatus.FAILED,
+})
+
+# terminal status -> the ``outcome`` tag serving records carry
+OUTCOMES = {
+    RequestStatus.COMPLETED: "ok",
+    RequestStatus.CANCELLED: "cancelled",
+    RequestStatus.TIMED_OUT: "timeout",
+    RequestStatus.REJECTED: "rejected",
+    RequestStatus.FAILED: "failed",
+}
 
 
 @dataclasses.dataclass
@@ -37,17 +61,40 @@ class Request:
     max_new_tokens: int = 64
     status: RequestStatus = RequestStatus.PENDING
     generated: List[int] = dataclasses.field(default_factory=list)
-    prefill_offset: int = 0     # prompt tokens already fed to the model
+    prefill_offset: int = 0     # prefill tokens already fed to the model
     slot: int = -1
     trace_id: str = ""          # stable per-request telemetry/trace tag
     # consecutive mixed-batch steps in which the tiled budget rounded this
     # request's prefill take to zero (starvation fallback, ADVICE r5 low)
     starved_steps: int = 0
+    # resilient serving (serve/resilience.py): scheduling priority (higher
+    # wins admission; preemption only ever evicts strictly-lower priority),
+    # an absolute deadline on the manager's clock, the host-side cancel
+    # flag reaped at step boundaries, and the terminal outcome tag
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    cancel_requested: bool = False
+    outcome: str = ""
+    preemptions: int = 0
+    requeues: int = 0
+    # preemption-and-recompute: after eviction the request re-prefills
+    # ``prompt + generated`` (KV is always recomputable from them);
+    # ``prefill_src`` is that feed (None = the prompt itself) and
+    # ``n_prefed`` how many generated tokens it contains — the correction
+    # ``seq_len`` needs while the recompute prefill is in flight.
+    prefill_src: Optional[List[int]] = None
+    n_prefed: int = 0
+
+    @property
+    def prefill_tokens(self) -> List[int]:
+        """The token sequence prefill feeds (prompt, or prompt+generated
+        while recovering from preemption)."""
+        return self.prompt if self.prefill_src is None else self.prefill_src
 
     @property
     def seq_len(self) -> int:
         """Tokens currently in the KV cache (after the last step)."""
-        return self.prefill_offset + len(self.generated)
+        return self.prefill_offset + len(self.generated) - self.n_prefed
 
 
 @dataclasses.dataclass
@@ -70,7 +117,10 @@ class RequestManager:
     request_cls = Request  # subclasses (SpecInferManager) extend the record
 
     def __init__(self, im, gen_config: Optional[GenerationConfig] = None,
-                 telemetry=None):
+                 telemetry=None, resilience: Optional[ResilienceConfig] = None,
+                 fault_injector=None, clock=None):
+        import time as _time
+
         self.im = im
         self.gen = gen_config or GenerationConfig()
         self.requests: Dict[int, Request] = {}
@@ -91,9 +141,37 @@ class RequestManager:
         self.telemetry = telemetry_or_null(telemetry)
         im.telemetry = self.telemetry
         self._tstamps: Dict[int, Dict[str, float]] = {}  # rid -> stamps
+        # resilient serving (serve/resilience.py): admission/deadline/
+        # preemption/retry policy + the seeded chaos hook.  The injector is
+        # synced onto the InferenceManager like the telemetry handle (same
+        # cached-im leak rationale); it is consulted at dispatch sites
+        # BEFORE any work reaches the device.
+        self.res = resilience or ResilienceConfig()
+        if self.res.kv_gate and self.res.kv_budget_bytes is not None:
+            from .resilience import kv_bytes_per_token
+
+            # an explicit BYTE cap needs the allocated caches to price
+            # requests in bytes — gating token-slot units against a byte
+            # budget would silently admit everything
+            if kv_bytes_per_token(im) is None:
+                raise ValueError(
+                    "kv_budget_bytes needs allocated KV caches to price "
+                    "requests in bytes; call init_operators_inference() "
+                    "before building the RequestManager (or use "
+                    "kv_headroom_frac, which gates in position units)")
+        self.injector = fault_injector
+        im.fault_injector = fault_injector
+        # deadline/TTL clock — serve_with_arrivals swaps in its loop clock
+        # for its duration so virtual-clock tests stay hermetic; _sleep is
+        # the retry backoff's wait (injectable for the same reason)
+        self.clock = clock or _time.perf_counter
+        self._sleep = _time.sleep
+        self._kv_bytes_tok: Optional[float] = None
 
     def _sample_arg(self):
-        """(key, temperature, top_p) for the step, or None for greedy."""
+        """Legacy per-call sampling arg ``(key, temperature, top_p)``, or
+        None for greedy — still used by the speculative phases, whose
+        verify/draft steps have no per-request token index to key on."""
         if self.gen.temperature <= 0.0:
             return None
         import jax
@@ -106,43 +184,388 @@ class RequestManager:
         return (key, jnp.float32(self.gen.temperature),
                 jnp.float32(self.gen.top_p))
 
+    @staticmethod
+    def _fold_for(req: Request) -> Tuple[int, int]:
+        """THE per-request sample-key fold: (rid, index of the token about
+        to be sampled).  Every sampled dispatch path must build its folds
+        through this one helper — the seeded bit-identity contract holds
+        only while step, decode-scan, and prefill-stretch agree on it."""
+        return (req.rid & 0x7FFFFFFF, len(req.generated))
+
+    def _sample_for(self, points, n_rows: int):
+        """Per-request sampling arg for an incremental step: ``(key,
+        temperature, top_p, folds)`` with ``folds[row] = (rid, n)`` for each
+        sample point — the key for request ``rid``'s ``n``-th generated
+        token is ``fold_in(fold_in(PRNGKey(seed), rid), n)``.
+
+        This schedule depends ONLY on (seed, rid, token index), so sampled
+        outputs are invariant to batch composition, arrival timing,
+        preemption-and-recompute, and dispatch retries — the resilient-
+        serving bit-identity contract (tests/test_resilience.py).  Rows
+        without a sample point draw from the (0, 0) fold; their samples are
+        computed and discarded.  None for greedy.
+        """
+        if self.gen.temperature <= 0.0:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        folds = np.zeros((n_rows, 2), np.int32)
+        for row, rid in points:
+            folds[row] = self._fold_for(self.requests[rid])
+        return (jax.random.PRNGKey(self.gen.seed),
+                jnp.float32(self.gen.temperature),
+                jnp.float32(self.gen.top_p), jnp.asarray(folds))
+
     # ------------------------------------------------------------------
     def _seq_len_needed(self, req: Request) -> int:
         """Cache depth a request may reach (overridden by speculation)."""
         return len(req.prompt) + req.max_new_tokens
 
+    def _validate_request(self, req: Request) -> Optional[str]:
+        """Host-side shape validation: the reason string, or None if OK.
+
+        Catching these HERE (satellite of ISSUE 5) turns what used to be a
+        device-side shape failure (cache writes past ``max_seq_len`` clamp
+        and corrupt the last slot) into a clear host error at registration.
+        """
+        if not req.prompt:
+            return "empty prompt"
+        if req.max_new_tokens < 0:
+            return f"max_new_tokens {req.max_new_tokens} < 0"
+        if len(req.prompt) > self.im.max_seq_len:
+            return (f"prompt length {len(req.prompt)} exceeds max_seq_len "
+                    f"{self.im.max_seq_len}")
+        need = self._seq_len_needed(req)
+        if need > self.im.max_seq_len:
+            return (f"request needs {need} cache slots (prompt "
+                    f"{len(req.prompt)} + max_new_tokens "
+                    f"{req.max_new_tokens}), exceeds max_seq_len "
+                    f"{self.im.max_seq_len}")
+        return None
+
+    def _kv_bytes_per_token(self) -> float:
+        """Per-position committed-KV cost for the admission gate (1.0 =
+        token-slot units until the caches are allocated)."""
+        if self._kv_bytes_tok is None:
+            from .resilience import kv_bytes_per_token
+
+            self._kv_bytes_tok = kv_bytes_per_token(self.im)
+        return self._kv_bytes_tok or 1.0
+
+    def _admission_reason(self, req: Request) -> Optional[str]:
+        """Capacity gate: the rejection reason, or None to admit.
+
+        Prices the new request's worst-case cache need against the bounded
+        pending queue and the KV headroom every live (pending + slotted)
+        request has already committed — ``plan_memory_bytes``-style
+        arithmetic over the allocated cache buffers.
+        """
+        res = self.res
+        if res.max_pending is not None and len(self.pending) >= res.max_pending:
+            return (f"pending queue full ({len(self.pending)} >= "
+                    f"{res.max_pending})")
+        if res.kv_gate:
+            per_tok = self._kv_bytes_per_token()
+            live = [self.requests[r] for r in self.pending] + [
+                r for r in self._active()
+                if r.status in (RequestStatus.PREFILLING,
+                                RequestStatus.DECODING)]
+            committed = sum(self._seq_len_needed(r) for r in live) \
+                + self._seq_len_needed(req)
+            # the budget: an explicit byte cap when configured (this is
+            # where the per-token BYTE pricing decides — int8 vs bf16 KV
+            # admit differently under the same cap), else the headroom
+            # fraction of the allocated cache's own position capacity
+            cap_bytes = (res.kv_budget_bytes
+                         if res.kv_budget_bytes is not None
+                         else res.kv_headroom_frac
+                         * self.im.max_requests * self.im.max_seq_len
+                         * per_tok)
+            if committed * per_tok > cap_bytes:
+                return (f"KV headroom: {committed * per_tok / 2**20:.2f}"
+                        f" MiB committed > {cap_bytes / 2**20:.2f} MiB "
+                        "budget")
+        return None
+
     def register_new_request(
-        self, prompt_tokens: Sequence[int], max_new_tokens: Optional[int] = None
+        self, prompt_tokens: Sequence[int],
+        max_new_tokens: Optional[int] = None, *,
+        priority: int = 0, ttl_s: Optional[float] = None,
+        deadline_s: Optional[float] = None, reject_invalid: bool = False,
+        reject_reason: Optional[str] = None,
     ) -> int:
-        if not len(prompt_tokens):
-            raise ValueError("empty prompt")
+        """Register a request; returns its rid.
+
+        Invalid shapes (empty prompt, negative ``max_new_tokens``, prompt or
+        prompt+max_new exceeding ``max_seq_len``) raise a host-side
+        ``ValueError`` — unless ``reject_invalid`` is set (the arrival loop
+        uses it), in which case the request is registered with a terminal
+        ``REJECTED`` outcome instead, so one malformed arrival can never
+        kill the serve loop.  Admission-control rejections (bounded queue /
+        KV headroom, see :class:`~.resilience.ResilienceConfig`) always
+        take the explicit ``REJECTED``-outcome path.  ``ttl_s`` (relative)
+        or ``deadline_s`` (absolute on the manager's clock) arm a per-
+        request deadline; ``max_new_tokens=0`` completes immediately with
+        an ``ok`` outcome and zero tokens.
+        """
+        req = self.request_cls(
+            -1,
+            list(int(t) for t in prompt_tokens),
+            self.gen.max_new_tokens if max_new_tokens is None else int(max_new_tokens),
+        )
+        # reject_reason: caller-side invalidity (e.g. malformed arrival
+        # options) that must take the REJECTED path like any shape error
+        err = reject_reason if reject_reason is not None \
+            else self._validate_request(req)
+        if err is not None and not reject_invalid:
+            raise ValueError(err)
         rid = self._next_rid
         self._next_rid += 1
-        req = self.request_cls(
-            rid,
-            list(int(t) for t in prompt_tokens),
-            self.gen.max_new_tokens if max_new_tokens is None else max_new_tokens,
-        )
-        if self._seq_len_needed(req) > self.im.max_seq_len:
-            raise ValueError(
-                f"request needs {self._seq_len_needed(req)} cache slots, "
-                f"exceeds max_seq_len {self.im.max_seq_len}"
-            )
+        req.rid = rid
         req.trace_id = f"r{rid:05d}"
+        req.priority = int(priority)
         self.requests[rid] = req
-        self.pending.append(rid)
         tel = self.telemetry
         if tel.enabled:
             self._tstamps[rid] = {
                 "enqueue": tel.request_enqueued(req.trace_id,
                                                 prompt_len=len(req.prompt))
             }
+        reason = err if err is not None else self._admission_reason(req)
+        if reason is not None:
+            req.status = RequestStatus.REJECTED
+            req.outcome = "rejected"
+            # shed load must not grow host memory: the prompt tokens of a
+            # rejected request are never served, so drop them — the
+            # retained record is a small fixed-size stub (backpressure
+            # would be pointless if every shed arrival kept its payload)
+            req.prompt = []
+            if tel.enabled:
+                tel.request_rejected(req.trace_id, reason=reason)
+            return rid
+        if req.max_new_tokens == 0:
+            # nothing to generate: terminal immediately, never takes a slot
+            req.status = RequestStatus.COMPLETED
+            req.outcome = "ok"
+            if tel.enabled:
+                tel.request_finished(req.trace_id, n_tokens=0)
+            return rid
+        if deadline_s is not None:
+            req.deadline_s = float(deadline_s)
+        else:
+            ttl = ttl_s if ttl_s is not None else self.res.default_ttl_s
+            if ttl is not None:
+                req.deadline_s = self.clock() + float(ttl)
+        self.pending.append(rid)
         return rid
 
-    def _admit(self):
+    # ------------------------------------------------------------------
+    # resilient-serving lifecycle: cancel / deadline / preempt / fail
+    # ------------------------------------------------------------------
+    def cancel(self, rid: int) -> bool:
+        """Request cancellation of ``rid``; returns whether it was live.
+
+        Takes effect at the NEXT host step boundary (``_check_lifecycle``):
+        the slot and KV release immediately there, already-committed tokens
+        are kept, and in-flight device work for the current step/scan is
+        never interrupted — scan results for other requests are unchanged.
+        A cancel issued while a decode STRETCH is in flight therefore lands
+        only when that stretch returns (up to ``scan_chunk`` steps; once
+        the flag is visible before dispatch, stretches are capped at
+        ``lifecycle_quantum`` steps, the same bound armed deadlines get).
+        """
+        req = self.requests.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        req.cancel_requested = True
+        return True
+
+    def _release_slot(self, req: Request) -> None:
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+
+    def _terminate(self, req: Request, status: RequestStatus,
+                   site: str = "") -> None:
+        """Move a request to a terminal status, releasing queue slot + KV.
+        The outcome tag derives from the one status->outcome table
+        (``OUTCOMES``) so the two can never drift; ``site`` attributes a
+        FAILED termination to the dispatch site that exhausted its
+        retries."""
+        if req.rid in self.pending:
+            self.pending.remove(req.rid)
+        self._release_slot(req)
+        req.prefill_src = None  # recompute feed is dead weight once terminal
+        req.status = status
+        req.outcome = OUTCOMES[status]
+        tel = self.telemetry
+        if tel.enabled:
+            n = len(req.generated)
+            if status is RequestStatus.CANCELLED:
+                tel.request_cancelled(req.trace_id, n_tokens=n)
+            elif status is RequestStatus.TIMED_OUT:
+                tel.request_timed_out(req.trace_id, n_tokens=n)
+            elif status is RequestStatus.FAILED:
+                tel.request_failed(req.trace_id, site=site)
+
+    def _swap_clock(self, new_clock):
+        """Switch the deadline clock, RE-BASING every live armed deadline
+        so its remaining budget is preserved — a TTL armed on the default
+        ``perf_counter`` clock must still fire correctly once
+        ``serve_with_arrivals`` swaps in an injected loop clock (and back).
+        Returns the previous clock for the symmetric restore."""
+        old = self.clock
+        if new_clock is old:
+            return old
+        live = [self.requests[r] for r in self.pending] + self._active()
+        armed = [r for r in live if r.deadline_s is not None]
+        if armed:
+            old_now, new_now = old(), new_clock()
+            for req in armed:
+                req.deadline_s = new_now + (req.deadline_s - old_now)
+        self.clock = new_clock
+        return old
+
+    def _check_lifecycle(self, now: Optional[float] = None) -> None:
+        """Step-boundary reaping of cancellations and deadline expiries —
+        the ONE place a live request can leave the engine for a reason
+        other than completing (host bookkeeping only; a reap between two
+        steps can never change other requests' results).
+
+        Scans only the LIVE requests (pending queue + slots), never the
+        full registration history, so per-tick cost stays O(live) over
+        long serving sessions.
+        """
+        live = [self.requests[r] for r in self.pending] + self._active()
+        expirable = [r for r in live
+                     if r.cancel_requested or r.deadline_s is not None]
+        if not expirable:
+            return
+        if now is None:
+            now = self.clock()
+        for req in expirable:
+            if req.cancel_requested:
+                self._terminate(req, RequestStatus.CANCELLED)
+            elif req.deadline_s is not None and now >= req.deadline_s:
+                self._terminate(req, RequestStatus.TIMED_OUT)
+
+    def preempt(self, rid: int) -> None:
+        """Evict a running request, releasing its slot + KV immediately.
+
+        The request re-enters the pending queue (status ``PREEMPTED``) and
+        on readmission RE-PREFILLS ``prompt + generated`` — recovery is
+        recompute-based, never KV-swap — after which its served tokens are
+        bit-identical to an unpreempted run for greedy AND seeded sampling
+        (the per-request sample-key schedule keys on (rid, token index)
+        only; pinned by tests/test_resilience.py, incl. int8 KV).
+        """
+        req = self.requests[rid]
+        if req.status not in (RequestStatus.PREFILLING,
+                              RequestStatus.DECODING):
+            raise ValueError(
+                f"cannot preempt request {rid} in status {req.status.name}")
+        self._release_slot(req)
+        req.prefill_src = list(req.prompt) + list(req.generated)
+        req.n_prefed = len(req.generated)
+        req.prefill_offset = 0
+        req.starved_steps = 0
+        req.status = RequestStatus.PREEMPTED
+        req.preemptions += 1
+        self.pending.append(rid)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.request_preempted(req.trace_id,
+                                  recompute_tokens=len(req.prefill_src))
+
+    # whether dispatch-failure recovery may requeue-and-recompute (the
+    # incremental paths re-prefill prompt+generated; SpecInferManager has
+    # no recompute story, so its failures go terminal regardless)
+    supports_recompute = True
+
+    def _rids_in_batch(self, bc) -> List[int]:
+        """The rids whose tokens are actually IN a built batch (a slotted
+        request can sit out a step, e.g. a prefill starved of budget —
+        dispatch failure must not touch it)."""
+        base = bc if isinstance(bc, BatchConfig) else bc.base
+        n = int(np.asarray(base.num_tokens))
+        slots = {int(s) for s in np.asarray(base.request_index)[:n]
+                 if int(s) >= 0}
+        return [self.slots[s] for s in sorted(slots)
+                if self.slots[s] is not None]
+
+    def _fail_inflight(self, site: str, exc: Exception,
+                       affected_fn=None) -> None:
+        """Dispatch exhausted its retry budget: degrade gracefully.
+
+        Only the requests whose tokens were in the failed batch
+        (``affected_fn``, defaulting to every running slotted request for
+        the stretch paths, where that is exact) are affected — per
+        ``res.on_dispatch_failure`` they are requeued for recompute
+        (bounded by ``max_requeues``) or failed terminally; everyone else
+        keeps serving.  Faults are injected/raised before dispatch, so no
+        partial device state exists to clean up.
+        """
+        if affected_fn is not None:
+            affected = [self.requests[rid] for rid in affected_fn()]
+        else:
+            affected = self._active()
+        affected = [r for r in affected
+                    if r.status in (RequestStatus.PREFILLING,
+                                    RequestStatus.DECODING)]
+        for req in affected:
+            if (self.supports_recompute
+                    and self.res.on_dispatch_failure == "requeue"
+                    and req.requeues < self.res.max_requeues):
+                req.requeues += 1
+                self.preempt(req.rid)
+            else:
+                self._terminate(req, RequestStatus.FAILED, site=site)
+
+    def _guarded(self, site: str, fn, affected_fn=None):
+        """Run one dispatch under the retry policy.
+
+        Retries :class:`~.resilience.TransientServeError` with exponential
+        backoff up to ``res.retry.max_retries`` times; a retried dispatch
+        replays identical compute (faults raise pre-dispatch; device KV
+        writes are positional and value-deterministic, so replay is
+        idempotent).  Returns ``fn()``, or None once the budget is
+        exhausted — the affected requests (``affected_fn``, evaluated only
+        then) were requeued or failed via :meth:`_fail_inflight` and the
+        serve loop continues.
+        """
+        pol = self.res.retry
+        tel = self.telemetry
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except TransientServeError as e:
+                if tel.enabled:
+                    tel.fault_observed(site, detail=str(e))
+                if attempt >= pol.max_retries:
+                    self._fail_inflight(site, e, affected_fn)
+                    return None
+                attempt += 1
+                delay = pol.backoff(attempt)
+                if tel.enabled:
+                    tel.dispatch_retry(site, attempt=attempt,
+                                       backoff_s=delay)
+                if delay > 0:
+                    self._sleep(delay)
+
+    # ------------------------------------------------------------------
+    def _pop_pending(self) -> int:
+        """Highest-priority pending rid, FIFO within a priority class."""
+        best = max(range(len(self.pending)),
+                   key=lambda i: (self.requests[self.pending[i]].priority,
+                                  -i))
+        return self.pending.pop(best)
+
+    def _fill_slots(self):
         for i, occupant in enumerate(self.slots):
             if occupant is None and self.pending:
-                rid = self.pending.pop(0)
+                rid = self._pop_pending()
                 req = self.requests[rid]
                 req.slot = i
                 req.status = RequestStatus.PREFILLING
@@ -150,11 +573,42 @@ class RequestManager:
                 tel = self.telemetry
                 if tel.enabled:
                     ts = self._tstamps.setdefault(rid, {})
-                    now = tel.request_admitted(
-                        req.trace_id,
-                        queue_wait_s=(tel.now() - ts["enqueue"]
-                                      if "enqueue" in ts else None))
-                    ts["admit"] = now
+                    # admission telemetry fires ONCE per request: a
+                    # preempted request's READMISSION must not double-count
+                    # requests_admitted or push its whole first service
+                    # period into the queue_wait histogram
+                    if "admit" not in ts:
+                        ts["admit"] = tel.request_admitted(
+                            req.trace_id,
+                            queue_wait_s=(tel.now() - ts["enqueue"]
+                                          if "enqueue" in ts else None))
+
+    def _try_preempt(self) -> bool:
+        """Preempt the lowest-priority DECODING request (newest first among
+        equals) iff a strictly-higher-priority request is waiting and no
+        slot is free.  Returns whether an eviction happened."""
+        if not self.pending or any(s is None for s in self.slots):
+            return False
+        head_pri = max(self.requests[r].priority for r in self.pending)
+        victims = [r for r in self._active()
+                   if r.status is RequestStatus.DECODING
+                   and r.priority < head_pri
+                   and r.preemptions < self.res.max_preemptions]
+        if not victims:
+            return False
+        victim = min(victims, key=lambda r: (r.priority, -r.rid))
+        self.preempt(victim.rid)
+        return True
+
+    def _admit(self):
+        self._fill_slots()
+        if self.res.preemption:
+            # bounded: each iteration either admits into a freed slot or
+            # stops (no admissible victim)
+            for _ in range(len(self.slots)):
+                if not (self.pending and self._try_preempt()):
+                    break
+                self._fill_slots()
 
     def _active(self) -> List[Request]:
         return [
@@ -214,19 +668,19 @@ class RequestManager:
                     continue
                 # cap at whole tiles so the padded segment fits the capacity
                 take = min((budget // tile) * tile,
-                           len(req.prompt) - req.prefill_offset)
+                           len(req.prefill_tokens) - req.prefill_offset)
                 start = req.prefill_offset
                 segments.append(
-                    (req.slot, req.prompt[start: start + take], start)
+                    (req.slot, req.prefill_tokens[start: start + take], start)
                 )
                 req.prefill_offset += take
                 req.starved_steps = 0
                 budget -= -(-take // tile) * tile  # padded tiles consumed
-                if req.prefill_offset == len(req.prompt):
+                if req.prefill_offset == len(req.prefill_tokens):
                     sample_points.append((req.slot, req.rid))
             seq_lens = np.zeros(self.im.max_requests, np.int32)
             for req in self._active():
-                seq_lens[req.slot] = req.prefill_offset + len(req.generated)
+                seq_lens[req.slot] = req.seq_len
             # LM-head gating: completing segments' sample points ride the
             # chunk's logit_slots, the step computes logits ONLY there, and
             # the result arrays are indexed by SLOT (shape [max_requests])
@@ -253,7 +707,7 @@ class RequestManager:
         for req in self._active():
             if req.status is not RequestStatus.PREFILLING or budget <= 0:
                 continue
-            remaining = len(req.prompt) - req.prefill_offset
+            remaining = len(req.prefill_tokens) - req.prefill_offset
             if remaining <= budget:
                 take = remaining
             elif (tile > 1 and self.im.use_pallas
@@ -293,22 +747,23 @@ class RequestManager:
                         take -= over
             start = req.prefill_offset
             for j in range(take):
-                tokens.append(req.prompt[start + j])
+                tokens.append(req.prefill_tokens[start + j])
                 req_idx.append(req.slot)
                 positions.append(start + j)
             req.prefill_offset += take
             req.starved_steps = 0
             budget -= take
-            if req.prefill_offset == len(req.prompt):
-                # output at the last prompt token = first generated token
+            if req.prefill_offset == len(req.prefill_tokens):
+                # output at the last prefill token = next generated token
                 sample_points.append((len(tokens) - 1, req.rid))
 
-        # cache depth after this step: prompt tokens fed so far + generated
-        # tokens (the decode token fed this step is generated[-1], whose KV
-        # lands at position seq_len-1 during the step)
+        # cache depth after this step: prefill tokens fed so far + generated
+        # tokens not already in the feed (the decode token fed this step is
+        # generated[-1], whose KV lands at position seq_len-1 during the
+        # step) — Request.seq_len is exactly that arithmetic
         seq_lens = np.zeros(self.im.max_requests, np.int32)
         for req in self._active():
-            seq_lens[req.slot] = req.prefill_offset + len(req.generated)
+            seq_lens[req.slot] = req.seq_len
         bc = BatchConfig.build(
             tokens, req_idx, positions, seq_lens,
             max_tokens=self.im.max_tokens,
@@ -368,9 +823,9 @@ class RequestManager:
                 and req.generated and req.generated[-1] == eos)
         ):
             req.status = RequestStatus.COMPLETED
-            if req.slot >= 0:
-                self.slots[req.slot] = None
-                req.slot = -1
+            req.outcome = "ok"
+            req.prefill_src = None  # recompute feed is dead once terminal
+            self._release_slot(req)
             tel = self.telemetry
             if tel.enabled:
                 ts = self._tstamps.get(req.rid, {})
@@ -398,6 +853,12 @@ class RequestManager:
         n = min(r.max_new_tokens - len(r.generated) for r in active)
         n = min(n, self.scan_chunk,
                 self.im.max_seq_len - max(r.seq_len for r in active) + 1)
+        # armed deadlines or pending cancels bound the stretch: lifecycle
+        # reaping happens at host step boundaries, so an uncapped scan
+        # would overshoot a deadline by up to scan_chunk device steps
+        if any(r.deadline_s is not None or r.cancel_requested
+               for r in active):
+            n = min(n, self.lifecycle_quantum)
         # round down to a power of two: n is a STATIC arg of the jitted
         # scan, so every distinct value compiles the whole n-step model —
         # quantizing bounds the compile count to ~log2(scan_chunk) variants
@@ -410,6 +871,10 @@ class RequestManager:
     # starved request falls back to an unaligned flat-path take (bounds the
     # TTFT inflation at ~limit decode steps; see prepare_next_batch)
     starvation_limit = 4
+    # decode-scan cap while any active request carries a deadline or a
+    # pending cancel: bounds how far past a deadline a stretch can run
+    # (lifecycle reaping is step-boundary-granular)
+    lifecycle_quantum = 8
 
     # ------------------------------------------------------------------
     def _prefill_stretch_possible(self) -> bool:
@@ -431,12 +896,12 @@ class RequestManager:
             and hasattr(self.im, "prefill_scan")
             and bool(active)
             and all(r.status is RequestStatus.PREFILLING for r in active)
-            and any(r.prefill_offset < len(r.prompt) for r in active)
+            and any(r.prefill_offset < len(r.prefill_tokens) for r in active)
             and all(r.prefill_offset % tile == 0 for r in active)
         )
 
     def _prefill_stretch(self) -> None:
-        """Prefill every active request's remaining prompt via prefill_scan."""
+        """Prefill every active request's remaining feed via prefill_scan."""
         import jax
         import jax.numpy as jnp
 
@@ -444,33 +909,41 @@ class RequestManager:
         tile = im.prefill_tile
         cap = im.max_tokens
         gate = bool(getattr(im, "gate_lm_head", False))
+        sampling = self.gen.temperature > 0.0
+        n_rows = im.max_requests if gate else cap
         chunks: List = []  # per-chunk numpy field tuples (BatchConfig order)
         ls_chunks: List = []  # per-chunk logit_slots (gated path)
+        fold_chunks: List = []  # per-chunk (rid, token-index) sample folds
         # (chunk_idx, result_idx, rid): result_idx is the SLOT when gated
         # (result arrays are [max_requests]), the flat token index otherwise
         points: List[Tuple[int, int, int]] = []
         seq = np.zeros(im.max_requests, np.int32)
         for req in self._active():
-            seq[req.slot] = req.prefill_offset + len(req.generated)
+            seq[req.slot] = req.seq_len
         for req in self._active():
             if req.status is not RequestStatus.PREFILLING:
                 continue
-            while req.prefill_offset < len(req.prompt):
+            while req.prefill_offset < len(req.prefill_tokens):
                 take = min((cap // tile) * tile,
-                           len(req.prompt) - req.prefill_offset)
+                           len(req.prefill_tokens) - req.prefill_offset)
                 start = req.prefill_offset
                 seq[req.slot] = start + take
                 fields, last_flat = PrefillBatchConfig.np_fields(
-                    [(req.slot, req.prompt[start: start + take], start)],
+                    [(req.slot, req.prefill_tokens[start: start + take],
+                      start)],
                     seq, tile,
                     max_tokens=cap, max_requests=im.max_requests,
                 )
                 req.prefill_offset += take
-                done = req.prefill_offset == len(req.prompt)
+                done = req.prefill_offset == len(req.prefill_tokens)
+                ridx = req.slot if gate else last_flat[req.slot]
                 if done:
-                    points.append((len(chunks),
-                                   req.slot if gate else last_flat[req.slot],
-                                   req.rid))
+                    points.append((len(chunks), ridx, req.rid))
+                if sampling:
+                    fc = np.zeros((n_rows, 2), np.int32)
+                    if done:
+                        fc[ridx] = self._fold_for(req)
+                    fold_chunks.append(fc)
                 ls_chunks.append(PrefillBatchConfig.np_logit_slots(
                     [req.slot] if done else [], last_flat, im.max_requests))
                 chunks.append(fields)
@@ -490,7 +963,26 @@ class RequestManager:
                 logit_slots=jnp.asarray(np.stack(ls_chunks[at: at + seg]))
                 if gate else None,
             )
-            outs.append((at, im.prefill_scan(stacked, self._sample_arg())))
+            smp = None
+            if sampling:
+                # per-request key schedule: the chunk carrying request rid's
+                # completion samples its token n with fold (rid, n) — same
+                # key whatever chunking/segmentation/preemption produced it
+                smp = (jax.random.PRNGKey(self.gen.seed),
+                       jnp.float32(self.gen.temperature),
+                       jnp.float32(self.gen.top_p),
+                       jnp.asarray(np.stack(fold_chunks[at: at + seg])))
+            res = self._guarded(
+                "prefill_scan", lambda s=stacked, a=smp: im.prefill_scan(s, a))
+            if res is None:
+                # dispatch failed past the retry budget: _fail_inflight
+                # already requeued/failed every prefilling request (their
+                # advanced offsets were reset by the recompute path) — the
+                # partial segments' KV is dead weight the next occupant of
+                # each slot overwrites
+                self.scan_runs += 1
+                return
+            outs.append((at, res))
             at += seg
         toks = {start: np.asarray(t) for start, t in outs}  # one sync
         starts = sorted(toks)
@@ -522,9 +1014,16 @@ class RequestManager:
             max_tokens=self.im.max_tokens, max_requests=self.im.max_requests,
         )
         eos = self.gen.eos_token_id if self.gen.stop_on_eos else None
-        toks, live, _ = self.im.decode_scan(
-            bc, n, eos=eos, sample=self._sample_arg()
-        )
+        # per-request sample keys: row i starts at (rid_i, len(generated_i))
+        # and the scan advances the token index per step on device
+        smp = self._sample_for(list(enumerate(points)), self.im.max_tokens)
+        out = self._guarded(
+            "decode_scan",
+            lambda: self.im.decode_scan(bc, n, eos=eos, sample=smp))
+        if out is None:
+            self.scan_runs += 1
+            return
+        toks, live, _ = out
         toks = np.asarray(toks)
         live = np.asarray(live)
         for s in range(n):
@@ -537,6 +1036,36 @@ class RequestManager:
         self.steps += n
         self.scan_runs += 1
 
+    def _serve_tick(self) -> None:
+        """One scheduling decision + dispatch of the incremental loop:
+        prefill stretch, decode stretch, or a single mixed step — every
+        dispatch runs under the retry guard, so a transient fault degrades
+        to requeue/reject of the affected requests instead of killing the
+        loop."""
+        tel = self.telemetry
+        if self._prefill_stretch_possible():
+            with tel.span("prefill_stretch", cat="serve"):
+                self._prefill_stretch()
+            return
+        n = self._scan_steps_possible()
+        if n > 1:
+            with tel.span("decode_stretch", cat="serve", steps=n):
+                self._decode_stretch(n)
+            return
+        with tel.span("serve_step", cat="serve"):
+            bc, sample_points = self.prepare_next_batch()
+            gated = (isinstance(bc, PrefillBatchConfig)
+                     and bc.logit_slots is not None)
+            smp = self._sample_for(
+                sample_points,
+                self.im.max_requests if gated else self.im.max_tokens)
+            result = self._guarded(
+                "step", lambda: self.im.step(bc, sample=smp),
+                affected_fn=lambda: self._rids_in_batch(bc))
+            if result is not None:
+                self.process_result(result, sample_points)
+            self.steps += 1
+
     def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8):
         """Arrival-driven serving: requests join the running admit/retire
         loop at their offered times (open-loop load, the serving_under_load
@@ -544,25 +1073,35 @@ class RequestManager:
 
         ``arrivals``: iterable of ``(t_offset_s, prompt_tokens,
         max_new_tokens_or_None)`` — offsets from loop start; admitted once
-        the clock passes them.  ``clock``: 0-arg seconds callable
-        (injectable for hermetic tests; default ``time.perf_counter``).
-        ``quantum``: cap on the on-device decode-scan stretch while
-        arrivals are outstanding, so a long scan can't defer admission
-        unboundedly (TTFT protection; the full ``scan_chunk`` window
-        returns once every arrival is in).
+        the clock passes them.  An optional 4th element is an options dict
+        forwarded to :meth:`register_new_request` (``priority``, ``ttl_s``,
+        ``deadline_s``).  ``clock``: 0-arg seconds callable (injectable for
+        hermetic tests; default ``time.perf_counter``); it also drives the
+        deadline/TTL checks for the loop's duration.  ``quantum``: cap on
+        the on-device decode-scan stretch while arrivals are outstanding,
+        so a long scan can't defer admission unboundedly (TTFT protection;
+        the full ``scan_chunk`` window returns once every arrival is in) —
+        cancellations and deadlines land at the same step-boundary
+        granularity.
 
         Returns ``{rid: record}`` with ``arrival_s``, ``first_token_s``
         (host-visible TTFT stamp), ``finish_s``, ``prompt_len``,
-        ``trace_id``, ``tokens``, and the TTFT decomposition
-        ``queue_wait_s`` / ``prefill_s``: ``prefill_start_s`` is stamped at
-        the start of the step in which the request's FIRST prompt token was
-        fed to the device, so queue wait (arrival -> prefill actually
-        starting: pending queue + slot wait + tiled-budget starvation) is
-        reported separately from prefill compute (``queue_wait_s +
-        prefill_s == first_token_s - arrival_s``).  All stamps are
-        host-visible at step-boundary granularity.  Per-request outputs are
-        INVARIANT to arrival timing (continuous batching only reorders
-        work, never results), pinned by tests/test_serving_under_load.py.
+        ``trace_id``, ``tokens``, a terminal ``outcome``
+        (``ok|cancelled|timeout|rejected|failed``), and the TTFT
+        decomposition ``queue_wait_s`` / ``prefill_s``: ``prefill_start_s``
+        is stamped at the start of the step in which the request's FIRST
+        prefill token was fed to the device, so queue wait (arrival ->
+        prefill actually starting: pending queue + slot wait + tiled-budget
+        starvation) is reported separately from prefill compute
+        (``queue_wait_s + prefill_s == first_token_s - arrival_s`` for
+        ``ok`` requests).  The decomposition and outcome are ALWAYS
+        emitted, including for requests that never produce a first token
+        (cancelled, rejected, timed out, ``max_new_tokens=0``) — their
+        ``prefill_s`` measures up to the terminal stamp instead.  All
+        stamps are host-visible at step-boundary granularity.  Per-request
+        outputs are INVARIANT to arrival timing (continuous batching only
+        reorders work, never results), pinned by
+        tests/test_serving_under_load.py.
         """
         import time as _time
 
@@ -571,38 +1110,71 @@ class RequestManager:
         pending = sorted(arrivals, key=lambda a: a[0])
         records: Dict[int, Dict] = {}
         saved_chunk = self.scan_chunk
+        saved_clock = self._swap_clock(clock)  # rebases armed deadlines
         tel = self.telemetry
+
+        # rids whose record still awaits a stamp — scanned per tick instead
+        # of the full (mostly-terminal) records history, so per-step host
+        # work stays O(live) over long sessions (same contract as
+        # _check_lifecycle)
+        open_rids: set = set()
 
         def admit_due():
             now = clock() - t0
             while pending and pending[0][0] <= now:
-                off, prompt, mnt = pending.pop(0)
-                rid = self.register_new_request(prompt, mnt)
+                off, prompt, mnt, *rest = pending.pop(0)
+                # malformed arrivals — bad prompt shapes AND bad options
+                # dicts — register as REJECTED records instead of raising
+                # out of (and killing) the serve loop
+                opts, reject = {}, None
+                if rest:
+                    known = {"priority", "ttl_s", "deadline_s"}
+                    if (isinstance(rest[0], dict)
+                            and not set(rest[0]) - known):
+                        try:
+                            opts = {
+                                k: (int(v) if k == "priority" else float(v))
+                                for k, v in rest[0].items() if v is not None}
+                        except (TypeError, ValueError):
+                            opts, reject = {}, \
+                                f"bad arrival options {rest[0]!r}"
+                    else:
+                        reject = f"bad arrival options {rest[0]!r}"
+                rid = self.register_new_request(
+                    prompt, mnt, reject_invalid=True,
+                    reject_reason=reject, **opts)
                 records[rid] = {"arrival_s": off, "admitted_s": now,
                                 "prompt_len": len(prompt),
                                 "trace_id": self.requests[rid].trace_id}
+                open_rids.add(rid)
             return clock() - t0
 
         def prefill_starters():
-            # requests whose first prompt token may enter the device in the
-            # NEXT step: stamped with the step's start time if it does
+            # requests whose first prefill token may enter the device in
+            # the NEXT step: stamped with the step's start time if it does
             # (admission itself can also happen inside the step)
-            return [rid for rid, rec in records.items()
-                    if "prefill_start_s" not in rec
-                    and self.requests[rid].prefill_offset == 0]
+            return [rid for rid in open_rids
+                    if "prefill_start_s" not in records[rid]
+                    and self.requests[rid].prefill_offset == 0
+                    and self.requests[rid].status not in TERMINAL_STATUSES]
 
         def stamp(now):
-            for rid, rec in records.items():
+            for rid in list(open_rids):
+                rec = records[rid]
                 req = self.requests[rid]
                 if "first_token_s" not in rec and req.generated:
                     rec["first_token_s"] = now
                 if ("finish_s" not in rec
-                        and req.status is RequestStatus.COMPLETED):
+                        and req.status in TERMINAL_STATUSES):
                     rec["finish_s"] = now
+                if "finish_s" in rec:
+                    open_rids.discard(rid)
 
         try:
             while pending or self.has_work():
                 now = admit_due()
+                self._check_lifecycle()
+                stamp(clock() - t0)
                 if not self.has_work():
                     # idle until the next arrival: a short bounded sleep for
                     # ANY clock — real clocks stop busy-spinning, virtual
@@ -614,22 +1186,7 @@ class RequestManager:
                     continue
                 self.scan_chunk = quantum if pending else saved_chunk
                 starters = prefill_starters()
-                if self._prefill_stretch_possible():
-                    with tel.span("prefill_stretch", cat="serve"):
-                        self._prefill_stretch()
-                else:
-                    n = self._scan_steps_possible()
-                    if n > 1:
-                        with tel.span("decode_stretch", cat="serve",
-                                      steps=n):
-                            self._decode_stretch(n)
-                    else:
-                        with tel.span("serve_step", cat="serve"):
-                            bc, sample_points = self.prepare_next_batch()
-                            result = self.im.step(bc,
-                                                  sample=self._sample_arg())
-                            self.process_result(result, sample_points)
-                            self.steps += 1
+                self._serve_tick()
                 for rid in starters:
                     if self.requests[rid].prefill_offset > 0:
                         records[rid]["prefill_start_s"] = now
@@ -639,38 +1196,40 @@ class RequestManager:
                 stamp(clock() - t0)
         finally:
             self.scan_chunk = saved_chunk
+            self._swap_clock(saved_clock)
+        end = clock() - t0
         for rid, rec in records.items():
-            rec["tokens"] = self.requests[rid].generated
-            start = rec.get("prefill_start_s", rec.get("admitted_s"))
-            if "first_token_s" in rec and start is not None:
-                rec["queue_wait_s"] = start - rec["arrival_s"]
-                rec["prefill_s"] = rec["first_token_s"] - start
+            req = self.requests[rid]
+            rec["tokens"] = req.generated
+            rec["outcome"] = req.outcome or OUTCOMES.get(req.status, "ok")
+            # ALWAYS emit the TTFT decomposition: queue wait runs from
+            # arrival to prefill start (falling back to registration, then
+            # arrival, when prefill never began); prefill runs from there
+            # to the first token (falling back to the terminal stamp)
+            start = rec.get("prefill_start_s",
+                            rec.get("admitted_s", rec["arrival_s"]))
+            stop = rec.get("first_token_s", rec.get("finish_s", end))
+            rec["queue_wait_s"] = max(start - rec["arrival_s"], 0.0)
+            rec["prefill_s"] = max(stop - start, 0.0)
         return records
 
     def serve_incr_decoding(self) -> Dict[int, List[int]]:
-        """Run the incremental-decoding loop until all requests complete.
+        """Run the incremental-decoding loop until all requests reach a
+        terminal state.
 
         Reference: ``RequestManager::serve_incr_decoding`` — but the pure-
         decode stretches run as ONE on-device ``lax.scan`` (EOS-masked), so
         the ~100ms tunnel sync amortizes over up to ``scan_chunk`` tokens;
         the per-step host path only handles admission/prefill boundaries.
+        Cancellations and deadline expiries are reaped at every step
+        boundary; transient dispatch faults retry-with-backoff and degrade
+        to requeue/fail of only the affected requests.
         """
-        tel = self.telemetry
-        while self.has_work():
-            if self._prefill_stretch_possible():
-                with tel.span("prefill_stretch", cat="serve"):
-                    self._prefill_stretch()
-                continue
-            n = self._scan_steps_possible()
-            if n > 1:
-                with tel.span("decode_stretch", cat="serve", steps=n):
-                    self._decode_stretch(n)
-                continue
-            with tel.span("serve_step", cat="serve"):
-                bc, sample_points = self.prepare_next_batch()
-                result = self.im.step(bc, sample=self._sample_arg())
-                self.process_result(result, sample_points)
-                self.steps += 1
+        while True:
+            self._check_lifecycle()
+            if not self.has_work():
+                break
+            self._serve_tick()
         return {rid: r.generated for rid, r in self.requests.items()}
 
     _serve = serve_incr_decoding  # overridden by SpecInferManager

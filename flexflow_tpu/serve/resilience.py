@@ -1,0 +1,200 @@
+"""Resilient serving: admission control, retry policy, fault injection.
+
+The serving stack through PR 4 is fail-fast: ``serve_with_arrivals`` admits
+from an unbounded queue, a request can never be cancelled or time out, and
+any transient dispatch/hop error kills the whole serve loop.  This module is
+the host-side policy layer the :class:`~flexflow_tpu.serve.request_manager.
+RequestManager` threads through its admit/retire loop (SpecInfer ASPLOS'24
+keeps ALL of this in the host-side RequestManager; Orca OSDI'22's
+iteration-level scheduling is what makes preemption-and-recompute natural —
+a request's KV is always recomputable from ``prompt + generated``):
+
+* :class:`ResilienceConfig` — admission control (bounded pending queue +
+  ``plan_memory_bytes``-style KV headroom arithmetic), default TTL,
+  preemption policy, and the dispatch-failure strategy;
+* :class:`RetryPolicy` — exponential backoff with a bounded budget for
+  transient dispatch faults;
+* :class:`FaultInjector` — a SEEDED, deterministic chaos hook consulted at
+  the InferenceManager's ``step``/``decode_scan``/``prefill_scan`` dispatch
+  sites and at every pipeline-parallel stage dispatch/hop.  Faults raise
+  BEFORE any work reaches the device, so a retried dispatch replays
+  identical compute — survivors of a chaos run are bit-identical to the
+  fault-free run (pinned by tests/test_resilience.py);
+* :func:`kv_bytes_per_token` — the per-position committed-KV cost the
+  admission gate prices new requests with.
+
+Everything here is host-side Python: no policy decision is ever traced into
+a jitted program, so attaching any of it cannot change compiled executables
+or their outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class TransientServeError(RuntimeError):
+    """A dispatch/hop failure that is worth retrying (the serve loop's
+    retry guard catches exactly this type; anything else propagates)."""
+
+
+class InjectedFault(TransientServeError):
+    """Raised by :class:`FaultInjector` at an instrumented dispatch site."""
+
+    def __init__(self, message: str, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with a bounded budget.
+
+    ``max_retries`` counts RE-dispatches after the first attempt
+    (``max_retries=0`` fails a dispatch on its first fault).  Backoff for
+    retry ``attempt`` (1-based) is ``backoff_s * backoff_mult**(attempt-1)``
+    capped at ``max_backoff_s``.
+    """
+
+    max_retries: int = 3
+    backoff_s: float = 0.01
+    backoff_mult: float = 2.0
+    max_backoff_s: float = 1.0
+
+    def backoff(self, attempt: int) -> float:
+        return min(self.backoff_s * self.backoff_mult ** max(attempt - 1, 0),
+                   self.max_backoff_s)
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Policy knobs the RequestManager's resilient serving layer reads.
+
+    Defaults keep every pre-existing behavior: unbounded pending queue, no
+    KV admission gate, no TTL, no preemption — resilience is strictly
+    opt-in per knob.
+
+    * ``max_pending``: bounded pending queue — registrations beyond it get
+      an explicit ``REJECTED`` outcome instead of silent unbounded growth.
+    * ``kv_gate`` / ``kv_headroom_frac`` / ``kv_budget_bytes``: admission
+      prices each request's worst-case cache need (``_seq_len_needed``
+      positions x :func:`kv_bytes_per_token`) against a byte budget,
+      summed over every live (pending + slotted) request — the same
+      arithmetic family ``plan_memory_bytes`` gates serve plans with.
+      ``kv_budget_bytes`` is an explicit cap (the knob under which int8
+      and bf16 KV admit differently); when None the budget is
+      ``kv_headroom_frac`` of the allocated cache's own capacity (pure
+      position counting, since the cache prices itself).
+    * ``default_ttl_s``: deadline applied to requests registered without an
+      explicit ``ttl_s``/``deadline_s`` (None = no deadline).
+    * ``preemption``: under slot pressure, evict the lowest-priority
+      ``DECODING`` request (newest first among equals, bounded by
+      ``max_preemptions``) to admit a strictly-higher-priority arrival; the
+      victim re-enters the queue and recomputes ``prompt + generated`` on
+      readmission, bit-identical to an unpreempted run.
+    * ``on_dispatch_failure``: once a dispatch exhausts its retry budget,
+      ``"requeue"`` recovers the affected requests by preempt-and-recompute
+      (bounded by ``max_requeues``, then ``FAILED``); ``"fail"`` fails them
+      immediately.  Either way the engine keeps serving everyone else.
+    """
+
+    max_pending: Optional[int] = None
+    kv_gate: bool = False
+    kv_headroom_frac: float = 1.0
+    kv_budget_bytes: Optional[float] = None
+    default_ttl_s: Optional[float] = None
+    preemption: bool = False
+    max_preemptions: int = 4
+    on_dispatch_failure: str = "requeue"
+    max_requeues: int = 2
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+
+    def __post_init__(self):
+        if self.on_dispatch_failure not in ("requeue", "fail"):
+            raise ValueError(
+                f"on_dispatch_failure {self.on_dispatch_failure!r} "
+                "(expected 'requeue' or 'fail')")
+
+
+class FaultInjector:
+    """Seeded, deterministic dispatch-fault injection (chaos testing).
+
+    Consulted host-side at each instrumented dispatch site BEFORE the work
+    is handed to the device — an injected fault therefore never leaves
+    partial device state behind, which is what makes retry-and-replay (and
+    requeue-and-recompute) bit-identical to a fault-free run.
+
+    ``p`` is the default per-call fault probability; ``p_by_site`` maps a
+    substring of the site name to an override (first match wins), e.g.
+    ``{"hop": 0.5}`` targets only pipeline stage hops, ``{"step": 1.0}``
+    the single-program step dispatch.  ``max_faults`` bounds the total
+    injected count — the lever that makes a seeded chaos run terminate
+    deterministically whatever the retry budget.
+    """
+
+    def __init__(self, seed: int = 0, p: float = 0.0,
+                 p_by_site: Optional[Dict[str, float]] = None,
+                 max_faults: Optional[int] = None):
+        self.p = float(p)
+        self.p_by_site = dict(p_by_site or {})
+        self.max_faults = max_faults
+        self.injected = 0
+        self.calls = 0
+        self._rng = np.random.RandomState(seed)
+
+    def prob(self, site: str) -> float:
+        for pat, pr in self.p_by_site.items():
+            if pat in site:
+                return float(pr)
+        return self.p
+
+    def maybe_fail(self, site: str) -> None:
+        """Raise :class:`InjectedFault` for ``site`` per the seeded draw.
+
+        Sites with probability 0 consume no randomness, so adding an
+        un-targeted dispatch site never perturbs the fault schedule of a
+        targeted one.
+        """
+        self.calls += 1
+        pr = self.prob(site)
+        if pr <= 0.0:
+            return
+        if self.max_faults is not None and self.injected >= self.max_faults:
+            return
+        if self._rng.random_sample() < pr:
+            self.injected += 1
+            raise InjectedFault(
+                f"injected fault #{self.injected} at {site}", site=site)
+
+
+def kv_bytes_per_token(im) -> Optional[float]:
+    """Committed-KV bytes ONE REQUEST's cache position costs across the
+    serve graph's attention ops (k + v planes and, under int8 KV, their
+    f32 scale planes).
+
+    Read off the ALLOCATED cache buffers (single-plan state or the merged
+    per-stage state of pipeline-parallel serving), so lane padding, kv
+    dtype, and sharding can never diverge from the ``plan_memory_bytes``
+    accounting that admitted the deployment.  Buffers are
+    ``[max_requests+1, heads, seq, dim]``, so the per-request-token price
+    divides by the REAL request rows as well as the seq axis; the pad-
+    scratch row's bytes amortize over the real rows, so
+    ``per_tok * max_requests * max_seq_len`` approximates the full cache
+    allocation (scratch row priced in, lane padding beyond ``max_seq_len``
+    not).  Returns None before
+    ``init_operators_inference`` allocates caches — the admission gate
+    then falls back to token-slot units.
+    """
+    state = getattr(im, "state", None)
+    if not state:
+        return None
+    total = 0.0
+    for bufs in state.values():
+        for name, arr in bufs.items():
+            if name in ("k", "v", "k_scale", "v_scale"):
+                rows = max(arr.shape[0] - 1, 1)  # minus the scratch row
+                total += arr.nbytes / (rows * arr.shape[2])
+    return total or None

@@ -79,6 +79,9 @@ class SpecInferManager(RequestManager):
     """
 
     request_cls = SpecRequest
+    # dispatch failures past the retry budget go terminal: the three-phase
+    # macro step's committed-depth bookkeeping has no recompute path
+    supports_recompute = False
 
     def __init__(
         self,
@@ -87,8 +90,22 @@ class SpecInferManager(RequestManager):
         gen_config: Optional[GenerationConfig] = None,
         width: int = 2,
         depth: int = 3,
+        telemetry=None,
+        resilience=None,
+        fault_injector=None,
+        clock=None,
     ):
-        super().__init__(llm, gen_config)
+        super().__init__(llm, gen_config, telemetry=telemetry,
+                         resilience=resilience,
+                         fault_injector=fault_injector, clock=clock)
+        if self.res.preemption:
+            # recompute-based preemption needs the incremental prefill
+            # paths (prefill_src); the spec macro-step's three-phase cache
+            # bookkeeping (llm/ssm committed depths) has no recompute story
+            raise ValueError(
+                "ResilienceConfig.preemption is not supported by "
+                "SpecInferManager (recovery is recompute-based and only "
+                "the incremental serving paths recompute)")
         self.llm = llm
         self.ssm = ssm
         self.width = width
@@ -146,8 +163,17 @@ class SpecInferManager(RequestManager):
                 break
             bc = self._plain_bc(self.llm, toks, reqi, pos)
             # sample arg so the first generated token (read off the last
-            # prompt position's logits) honors temperature/top_p
-            result = self.llm.step(bc, sample=self._sample_arg())
+            # prompt position's logits) honors temperature/top_p.  All
+            # phase dispatches run under the retry guard: a fault past the
+            # budget fails only the in-flight requests (no recompute here).
+            # The sample key is drawn ONCE outside the guard so a retried
+            # dispatch replays the identical step.
+            smp = self._sample_arg()
+            result = self._guarded(
+                "spec_prefill",
+                lambda b=bc, s=smp: self.llm.step(b, sample=s))
+            if result is None:
+                return
             self.llm_steps += 1
             ids = np.asarray(result.token_ids)
             for flat, rid in points:
@@ -183,7 +209,10 @@ class SpecInferManager(RequestManager):
                     budget -= take
             if not toks:
                 break
-            self.ssm.step(self._plain_bc(self.ssm, toks, reqi, pos))
+            bc = self._plain_bc(self.ssm, toks, reqi, pos)
+            if self._guarded("spec_ssm_prefill",
+                             lambda b=bc: self.ssm.step(b)) is None:
+                return
 
     def _plain_bc(self, im, toks, reqi, pos):
         seq_lens = np.zeros(im.max_requests, np.int32)
@@ -227,7 +256,10 @@ class SpecInferManager(RequestManager):
                 TreeSearchBatchConfig, self.ssm, toks, reqi, pos, spec, masks,
                 committed_attr="ssm_committed",
             )
-            result = self.ssm.step(bc)
+            result = self._guarded("spec_draft",
+                                   lambda b=bc: self.ssm.step(b))
+            if result is None:
+                return []
             topk_ids = np.asarray(result.topk_ids)
             topk_lp = np.asarray(result.topk_logprobs)
             # beam-select the next frontier per request
@@ -331,7 +363,11 @@ class SpecInferManager(RequestManager):
         # distribution equals plain sampled incremental decoding's (see
         # spec_scan._macro_body for the acceptance-rate tradeoff vs the
         # p/q-ratio rule).  T<=0 keeps the exact-greedy walk.
-        result = self.llm.step(bc, sample=self._sample_arg())
+        smp = self._sample_arg()
+        result = self._guarded(
+            "spec_verify", lambda: self.llm.step(bc, sample=smp))
+        if result is None:
+            return
         self.llm_steps += 1
         ids = np.asarray(result.token_ids)
 
@@ -379,8 +415,15 @@ class SpecInferManager(RequestManager):
 
     # ------------------------------------------------------------------
     def serve_spec_infer(self) -> Dict[int, List[int]]:
-        """Reference: ``RequestManager::serve_spec_infer``."""
-        while self.has_work():
+        """Reference: ``RequestManager::serve_spec_infer``.
+
+        Cancellations and deadline expiries are reaped at macro-step
+        boundaries (the speculative analogue of the incremental loop's
+        step-boundary checks)."""
+        while True:
+            self._check_lifecycle()
+            if not self.has_work():
+                break
             self._prefill_phase()
             drafting = self._draft_phase()
             self._verify_phase(drafting)

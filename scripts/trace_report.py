@@ -5,9 +5,12 @@ Usage::
     python scripts/trace_report.py artifacts/telemetry/serve.jsonl
 
 Prints one JSON document: request counts, p50/p95 TTFT / TPOT /
-queue-wait (derived from the request-lifecycle events), per-track span
-totals (pipeline stage interleave), the pp bubble fraction, and the
-per-plan predicted-vs-measured error table from the calibration ledger.
+queue-wait (derived from the request-lifecycle events), the terminal
+outcome mix and resilience counters (rejected / cancelled / timeout /
+preempted / failed, dispatch retries + faults, recompute tokens),
+per-track span totals (pipeline stage interleave), the pp bubble
+fraction, and the per-plan predicted-vs-measured error table from the
+calibration ledger.
 
 The reduction itself lives in :mod:`flexflow_tpu.obs.report`
 (``summarize_jsonl``) so ``bench.py --dry-run``'s observability section and

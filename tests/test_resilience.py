@@ -1,0 +1,497 @@
+"""Resilient serving tests (ISSUE 5): admission control, deadlines and
+cancellation, preemption-and-recompute, and fault-injected dispatch retry.
+
+The load-bearing contracts:
+
+* admission is an explicit ``REJECTED`` outcome, never silent queue growth
+  or a mid-loop exception;
+* cancel/deadline land at the NEXT step boundary, releasing slot + KV,
+  and never change other requests' results;
+* preemption-and-recompute is BIT-IDENTICAL to an unpreempted run — greedy
+  and seeded sampling, bf16 and int8 KV — because KV is recomputed from
+  ``prompt + generated`` and the per-request sample-key schedule keys on
+  (rid, token index) only;
+* a seeded FaultInjector chaos run terminates with every request in a
+  terminal outcome, zero engine crashes, and survivors bit-identical to
+  the fault-free run (faults raise before dispatch; replay is idempotent).
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import Telemetry
+from flexflow_tpu.serve import (
+    FaultInjector,
+    GenerationConfig,
+    RequestManager,
+    RequestStatus,
+    ResilienceConfig,
+    RetryPolicy,
+)
+from flexflow_tpu.serve.resilience import InjectedFault, kv_bytes_per_token
+
+from test_serve import TINY, make_im, ref_greedy_decode
+from test_serving_under_load import VirtualClock
+
+
+def quiet(rm):
+    """No real sleeping in retry backoff (hermetic tests)."""
+    rm._sleep = lambda s: None
+    return rm
+
+
+class TriggerClock(VirtualClock):
+    """VirtualClock that fires a callback once ``ready()`` is true — the
+    injection point for cancel/preempt mid-serve (host-side, between
+    steps, like an external control plane would).  Predicate-based so the
+    trigger lands deterministically at a specific serving phase instead of
+    a wall-clock offset."""
+
+    def __init__(self, ready, fn, tick=0.01):
+        super().__init__(tick)
+        self.ready = ready
+        self.fn = fn
+        self.fired = False
+
+    def __call__(self):
+        t = super().__call__()
+        if not self.fired and self.ready():
+            self.fired = True
+            self.fn()
+        return t
+
+
+# ---------------------------------------------------------------------------
+# registration validation (satellite: host-side ValueError, not device shapes)
+# ---------------------------------------------------------------------------
+def test_register_rejects_bad_shapes_host_side():
+    im = make_im(max_seq=32)
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4))
+    with pytest.raises(ValueError, match="prompt length 40 exceeds"):
+        rm.register_new_request(list(range(1, 41)))
+    with pytest.raises(ValueError, match="cache slots"):
+        rm.register_new_request([3, 5, 7], max_new_tokens=30)
+    with pytest.raises(ValueError, match="empty prompt"):
+        rm.register_new_request([])
+    with pytest.raises(ValueError, match="max_new_tokens -1"):
+        rm.register_new_request([3], max_new_tokens=-1)
+    assert not rm.has_work(), "failed registrations must not enqueue"
+
+
+def test_zero_max_new_tokens_completes_immediately():
+    im = make_im(max_seq=64)
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=6))
+    outs = rm.generate([[3, 5, 7], [2, 4]], max_new_tokens=0)
+    assert outs == [[], []]
+    assert all(r.status is RequestStatus.COMPLETED and r.outcome == "ok"
+               for r in rm.requests.values())
+    assert rm.steps == 0, "nothing should have been dispatched"
+
+
+# ---------------------------------------------------------------------------
+# admission control: bounded queue + KV headroom -> explicit REJECTED
+# ---------------------------------------------------------------------------
+def test_bounded_queue_rejects_and_serves_the_rest():
+    im = make_im(max_seq=64)
+    tel = Telemetry()
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4),
+                        telemetry=tel,
+                        resilience=ResilienceConfig(max_pending=2))
+    prompts = [[3, 5, 7], [2, 4, 6], [11, 13], [9, 8, 1]]
+    outs = rm.generate(prompts)
+    statuses = [rm.requests[r].status for r in sorted(rm.requests)]
+    assert statuses[:2] == [RequestStatus.COMPLETED] * 2
+    assert statuses[2:] == [RequestStatus.REJECTED] * 2
+    assert outs[2] == [] and outs[3] == []
+    assert tel.metrics.counter("requests_rejected").value == 2
+    # the admitted requests match serving them alone (rejects are inert)
+    for p, got in zip(prompts[:2], outs[:2]):
+        im.reset()
+        solo = RequestManager(im, GenerationConfig(max_new_tokens=4))
+        assert solo.generate([p])[0] == got
+
+
+def test_kv_headroom_gate_prices_seq_len_needed():
+    im = make_im(max_seq=32, max_requests=2)
+    rm = RequestManager(
+        im, GenerationConfig(max_new_tokens=20),
+        resilience=ResilienceConfig(kv_gate=True, kv_headroom_frac=0.5))
+    # capacity = 2 slots x 32 positions; headroom 0.5 -> 32 positions.
+    # each request commits 4 + 20 = 24 positions: first admits, second not
+    r1 = rm.register_new_request([3, 5, 7, 9])
+    r2 = rm.register_new_request([2, 4, 6, 8])
+    assert rm.requests[r1].status is RequestStatus.PENDING
+    assert rm.requests[r2].status is RequestStatus.REJECTED
+
+
+def test_kv_budget_bytes_is_a_real_byte_cap():
+    im = make_im(max_seq=32, max_requests=2)
+    per_tok = kv_bytes_per_token(im)
+    assert per_tok and per_tok > 0, "allocated caches must price the gate"
+    # the price is PER REQUEST-TOKEN: the full commitment of all slots at
+    # max depth approximates the actual cache allocation (scratch row
+    # amortized in, lane padding beyond max_seq_len not priced)
+    alloc = sum(arr.nbytes for bufs in im.state.values()
+                for name, arr in bufs.items()
+                if name in ("k", "v", "k_scale", "v_scale"))
+    full = per_tok * im.max_requests * im.max_seq_len
+    assert 0.2 * alloc <= full <= 1.1 * alloc
+    # an explicit byte budget sized for exactly one request's commitment:
+    # the per-token BYTE price decides (int8 KV would admit ~2x more here)
+    budget = per_tok * 24 * 1.5
+    rm = RequestManager(
+        im, GenerationConfig(max_new_tokens=20),
+        resilience=ResilienceConfig(kv_gate=True, kv_budget_bytes=budget))
+    r1 = rm.register_new_request([3, 5, 7, 9])   # 24 positions -> fits
+    r2 = rm.register_new_request([2, 4, 6, 8])   # 48 > 36 -> rejected
+    assert rm.requests[r1].status is RequestStatus.PENDING
+    assert rm.requests[r2].status is RequestStatus.REJECTED
+
+
+# ---------------------------------------------------------------------------
+# cancellation & deadlines at step boundaries
+# ---------------------------------------------------------------------------
+def test_cancel_mid_decode_scan_other_requests_unchanged():
+    im = make_im(max_seq=64)
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    # oracle: both served to completion, no cancellation
+    rm0 = RequestManager(im, GenerationConfig(max_new_tokens=12))
+    want = rm0.generate(prompts)
+
+    im.reset()
+    rm = quiet(RequestManager(im, GenerationConfig(max_new_tokens=12)))
+    rm.scan_chunk = 2  # several short scans -> cancel lands between them
+    arrivals = [(0.0, prompts[0], 12), (0.0, prompts[1], 12)]
+    clock = TriggerClock(
+        ready=lambda: 2 <= len(rm.requests.get(1).generated) < 11
+        if 1 in rm.requests else False,
+        fn=lambda: rm.cancel(1))
+    records = rm.serve_with_arrivals(arrivals, clock=clock)
+    assert clock.fired, "cancel trigger never armed"
+    cancelled = records[1]
+    assert cancelled["outcome"] == "cancelled"
+    # cancel landed at a step boundary: tokens committed before it are
+    # kept, are a prefix of the uncancelled run, and the scan results of
+    # the OTHER request are bit-identical to the no-cancel run
+    assert 0 < len(cancelled["tokens"]) < 12
+    assert cancelled["tokens"] == want[1][: len(cancelled["tokens"])]
+    assert records[0]["outcome"] == "ok"
+    assert records[0]["tokens"] == want[0]
+    # decomposition always present, even for the cancelled request
+    for rec in records.values():
+        assert "queue_wait_s" in rec and "prefill_s" in rec
+
+
+def test_cancel_mid_prefill_releases_slot_and_next_occupant_is_clean():
+    im = make_im(max_tokens=4, max_seq=40)  # 11-token prompt -> 3 chunks
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4))
+    rid = rm.register_new_request(list(range(1, 12)))
+    # hand-drive one mixed step: the first prefill chunk enters the device
+    bc, pts = rm.prepare_next_batch()
+    rm.process_result(im.step(bc), pts)
+    req = rm.requests[rid]
+    assert req.status is RequestStatus.PREFILLING and req.prefill_offset > 0
+    assert rm.cancel(rid)
+    assert req.status is RequestStatus.PREFILLING, \
+        "cancel must wait for the step boundary"
+    rm.serve_incr_decoding()  # boundary check reaps it immediately
+    assert req.status is RequestStatus.CANCELLED and req.slot == -1
+    assert req.generated == []
+    # a new request admits into the freed slot over the stale partial KV
+    # and still matches the independent full-context reference
+    prompt = [3, 11, 25, 40, 7]
+    out = rm.generate([prompt], max_new_tokens=4)[0]
+    assert out == ref_greedy_decode(im.params, TINY, prompt, 4)
+
+
+def test_deadline_timeout_in_queue():
+    im = make_im(max_seq=64)
+    tel = Telemetry()
+    rm = quiet(RequestManager(im, GenerationConfig(max_new_tokens=8),
+                              telemetry=tel))
+    # 3 arrivals into 2 slots; the third's TTL expires while it queues
+    # behind the decode work (virtual clock: each reading advances 10ms)
+    arrivals = [
+        (0.0, [3, 11, 25, 40, 7], 8),
+        (0.0, [2, 4, 6, 8], 8),
+        (0.0, [9, 1, 5], 8, {"ttl_s": 0.05}),
+    ]
+    records = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert records[2]["outcome"] == "timeout"
+    assert records[2]["tokens"] == []
+    assert "queue_wait_s" in records[2] and "prefill_s" in records[2]
+    assert records[0]["outcome"] == "ok" and records[1]["outcome"] == "ok"
+    assert tel.metrics.counter("requests_timeout").value == 1
+
+
+def test_ttl_armed_before_clock_swap_still_fires():
+    # a TTL armed on the DEFAULT perf_counter clock must rebase when
+    # serve_with_arrivals swaps in an injected loop clock — without the
+    # rebase the perf_counter-scale deadline never fires on a virtual now
+    im = make_im(max_seq=64)
+    rm = quiet(RequestManager(
+        im, GenerationConfig(max_new_tokens=8),
+        resilience=ResilienceConfig(default_ttl_s=0.01)))
+    rid = rm.register_new_request([3, 5, 7])
+    rm.serve_with_arrivals([], clock=VirtualClock())
+    assert rm.requests[rid].status is RequestStatus.TIMED_OUT
+    assert rm.requests[rid].outcome == "timeout"
+
+
+def test_arrival_records_reject_invalid_instead_of_crashing():
+    im = make_im(max_seq=32)
+    rm = quiet(RequestManager(im, GenerationConfig(max_new_tokens=4)))
+    arrivals = [
+        (0.0, [3, 5, 7], 4),
+        (0.0, list(range(1, 41)), 4),   # prompt > max_seq_len
+        (0.01, [], 4),                  # empty prompt
+        (0.01, [2, 4], 0),              # max_new_tokens=0: ok, no tokens
+    ]
+    records = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert len(records) == 4
+    outcomes = sorted(r["outcome"] for r in records.values())
+    assert outcomes == ["ok", "ok", "rejected", "rejected"]
+    assert records[3]["outcome"] == "ok" and records[3]["tokens"] == []
+    for rec in records.values():
+        # the decomposition + terminal stamps are ALWAYS emitted, first
+        # token or not (the satellite's exact contract)
+        assert "queue_wait_s" in rec and "prefill_s" in rec
+        assert "finish_s" in rec and "tokens" in rec
+
+
+# ---------------------------------------------------------------------------
+# preemption-and-recompute bit-identity
+# ---------------------------------------------------------------------------
+def _serve_with_midway_preempt(im, gen, prompts, preempt_rid):
+    rm = quiet(RequestManager(im, gen))
+    arrivals = [(0.0, p, gen.max_new_tokens) for p in prompts]
+    rm.scan_chunk = 2
+
+    def ready():
+        req = rm.requests.get(preempt_rid)
+        return (req is not None
+                and req.status is RequestStatus.DECODING
+                and 2 <= len(req.generated) < gen.max_new_tokens - 1)
+
+    clock = TriggerClock(ready, fn=lambda: rm.preempt(preempt_rid))
+    records = rm.serve_with_arrivals(arrivals, clock=clock)
+    assert clock.fired, "preempt trigger never armed"
+    return rm, records
+
+
+def _preempt_im(kv_dtype):
+    # the int8 variant rides the exact config test_kv_int8 already
+    # compiled (cache reuse keeps tier-1 time flat)
+    return (make_im(max_tokens=8, max_requests=2, max_seq=32,
+                    use_pallas=True, kv_dtype="int8")
+            if kv_dtype else make_im(max_seq=64))
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_preempt_recompute_bit_identical_greedy(kv_dtype):
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _preempt_im(kv_dtype)
+    want = RequestManager(im, gen).generate(prompts)
+    im.reset()
+    rm, records = _serve_with_midway_preempt(im, gen, prompts, preempt_rid=0)
+    assert rm.requests[0].preemptions == 1, "preemption did not trigger"
+    got = [records[r]["tokens"] for r in sorted(records)]
+    assert got == want, "preempt-and-recompute diverged from unpreempted run"
+    assert all(r["outcome"] == "ok" for r in records.values())
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_preempt_recompute_bit_identical_seeded_sampling(kv_dtype):
+    # the full acceptance matrix: seeded sampling on bf16 AND int8 KV
+    # (the int8 cell catches fold/row misalignment interacting with the
+    # dequant scale planes)
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=10, temperature=0.8, top_p=0.9,
+                           seed=11)
+    im = _preempt_im(kv_dtype)
+    want = RequestManager(im, gen).generate(prompts)
+    assert all(0 <= t < TINY.vocab_size for o in want for t in o)
+    im.reset()
+    rm, records = _serve_with_midway_preempt(im, gen, prompts, preempt_rid=0)
+    assert rm.requests[0].preemptions == 1
+    got = [records[r]["tokens"] for r in sorted(records)]
+    # the per-request (rid, token-index) key schedule makes the sampled
+    # stream preemption-invariant — this is the tentpole's seeded-sampling
+    # bit-identity acceptance gate
+    assert got == want, "sample-key schedule is not preemption-invariant"
+
+
+def test_sampling_invariant_to_batch_composition():
+    # same schedule property, no preemption: a request sampled solo equals
+    # the same request sampled while batched with another (rid-keyed keys)
+    gen = GenerationConfig(max_new_tokens=8, temperature=0.7, seed=3)
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    im = make_im(max_seq=64)
+    batched = RequestManager(im, gen).generate(prompts)
+    im.reset()
+    solo = RequestManager(im, gen)  # rid 0 matches the batched run's rid 0
+    assert solo.generate([prompts[0]])[0] == batched[0]
+
+
+def test_priority_admission_preempts_lowest_priority():
+    im = make_im(max_seq=64, max_requests=2)
+    tel = Telemetry()
+    gen = GenerationConfig(max_new_tokens=8)
+    rm = quiet(RequestManager(
+        im, gen, telemetry=tel,
+        resilience=ResilienceConfig(preemption=True)))
+    arrivals = [
+        (0.0, [3, 11, 25, 40, 7], 8),
+        (0.0, [2, 4, 6, 8], 8),
+        (0.02, [9, 1, 5], 8, {"priority": 5}),  # arrives under full slots
+    ]
+    rm.scan_chunk = 2
+    records = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert tel.metrics.counter("requests_preempted").value >= 1
+    assert all(r["outcome"] == "ok" for r in records.values())
+    # every request's tokens still equal its solo run (recompute exactness)
+    for rid in sorted(records):
+        prompt = arrivals[rid][1]
+        im.reset()
+        solo = RequestManager(im, GenerationConfig(max_new_tokens=8))
+        assert records[rid]["tokens"] == solo.generate([prompt])[0]
+
+
+# ---------------------------------------------------------------------------
+# fault injection + retry
+# ---------------------------------------------------------------------------
+def test_retry_policy_backoff_schedule():
+    pol = RetryPolicy(max_retries=3, backoff_s=0.05, backoff_mult=2.0,
+                      max_backoff_s=0.15)
+    assert pol.backoff(1) == 0.05
+    assert pol.backoff(2) == 0.10
+    assert pol.backoff(3) == 0.15  # capped
+
+
+def test_fault_injector_is_deterministic_and_site_targeted():
+    a = FaultInjector(seed=4, p=0.5)
+    b = FaultInjector(seed=4, p=0.5)
+    sched_a, sched_b = [], []
+    for sched, inj in ((sched_a, a), (sched_b, b)):
+        for i in range(40):
+            try:
+                inj.maybe_fail("step")
+            except InjectedFault:
+                sched.append(i)
+    assert sched_a == sched_b and sched_a, "seeded schedule must reproduce"
+    hop_only = FaultInjector(seed=0, p_by_site={"hop": 1.0})
+    hop_only.maybe_fail("step")  # untargeted site: never fails, no draw
+    with pytest.raises(InjectedFault):
+        hop_only.maybe_fail("stage1_hop")
+
+
+@pytest.mark.chaos
+def test_chaos_run_terminates_with_bit_identical_survivors():
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8], [33, 1], [9, 8, 1, 5]]
+    gen = GenerationConfig(max_new_tokens=6)
+    im = make_im(max_seq=64)
+    want = RequestManager(im, gen).generate(prompts)
+
+    im.reset()
+    tel = Telemetry()
+    inj = FaultInjector(seed=1, p=0.3, max_faults=4)
+    rm = quiet(RequestManager(
+        im, gen, telemetry=tel, fault_injector=inj,
+        resilience=ResilienceConfig(retry=RetryPolicy(max_retries=5,
+                                                      backoff_s=0.0))))
+    got = rm.generate(prompts)
+    assert inj.injected == 4, "seeded faults did not all fire"
+    assert tel.metrics.counter("dispatch_retries").value >= 4
+    # every request reached a terminal outcome, zero engine crashes, and
+    # (retry budget > max_faults) every survivor is bit-identical
+    from flexflow_tpu.serve import TERMINAL_STATUSES
+
+    assert all(r.status in TERMINAL_STATUSES for r in rm.requests.values())
+    assert got == want, "chaos run diverged from the fault-free run"
+
+
+@pytest.mark.chaos
+def test_exhausted_retries_requeue_and_recompute_bit_identical():
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=6)
+    im = make_im(max_seq=64)
+    want = RequestManager(im, gen).generate(prompts)
+    im.reset()
+    inj = FaultInjector(seed=0, p=1.0, max_faults=2)  # 2 sure faults
+    rm = quiet(RequestManager(
+        im, gen, fault_injector=inj,
+        resilience=ResilienceConfig(
+            retry=RetryPolicy(max_retries=0),   # no retry: straight to
+            on_dispatch_failure="requeue")))    # requeue-and-recompute
+    got = rm.generate(prompts)
+    assert inj.injected == 2
+    assert got == want, "requeue-and-recompute diverged"
+    assert all(r.requeues >= 1 for r in rm.requests.values())
+
+
+@pytest.mark.chaos
+def test_exhausted_retries_fail_mode_keeps_engine_alive():
+    im = make_im(max_seq=64)
+    tel = Telemetry()
+    inj = FaultInjector(seed=0, p=1.0)  # every dispatch faults, forever
+    rm = quiet(RequestManager(
+        im, GenerationConfig(max_new_tokens=6), telemetry=tel,
+        fault_injector=inj,
+        resilience=ResilienceConfig(retry=RetryPolicy(max_retries=1),
+                                    on_dispatch_failure="fail")))
+    got = rm.generate([[3, 5, 7], [2, 4]])
+    assert got == [[], []]
+    assert all(r.status is RequestStatus.FAILED and r.outcome == "failed"
+               for r in rm.requests.values())
+    assert tel.metrics.counter("requests_failed").value == 2
+
+
+@pytest.mark.chaos
+def test_spec_infer_dispatch_faults_retry_to_bit_identity():
+    # the speculative macro-step's phase dispatches are guarded too; its
+    # failure mode is terminal (no recompute story), but retried faults
+    # within budget must leave the greedy spec == incremental invariant
+    from flexflow_tpu.serve import SpecInferManager
+    from test_spec_infer import TINY_SSM
+
+    prompt = [3, 11, 25, 40, 7]
+    # the spec_rig configs test_spec_infer already compiled (cache reuse)
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=TINY_SSM, topk=2, seed=123)
+    want = RequestManager(llm, GenerationConfig(max_new_tokens=6)).generate(
+        [prompt])[0]
+    llm.reset()
+    ssm.reset()
+    inj = FaultInjector(seed=3, p=0.3, max_faults=3)
+    sm = quiet(SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=6),
+        fault_injector=inj,
+        resilience=ResilienceConfig(retry=RetryPolicy(max_retries=5,
+                                                      backoff_s=0.0))))
+    got = sm.generate([prompt])[0]
+    assert inj.injected == 3
+    assert got == want, "spec chaos run diverged from incremental greedy"
+
+
+@pytest.mark.chaos
+def test_pp_stage_hop_faults_retry_to_bit_identity():
+    # the pipeline-parallel hop sites: a seeded injector targeting only
+    # inter-stage hops; retries replay the macro-step (stage KV writes are
+    # positional + value-deterministic, so replay is idempotent)
+    from test_pp_serve import make_pp_im
+
+    prompt = [3, 11, 25, 40, 7]
+    pim = make_pp_im({"pp": 2})
+    want = RequestManager(pim, GenerationConfig(max_new_tokens=6)).generate(
+        [prompt])[0]
+    pim.init_operators_inference(rng=__import__("jax").random.PRNGKey(7))
+    inj = FaultInjector(seed=2, p_by_site={"hop": 0.5}, max_faults=2)
+    rm = quiet(RequestManager(
+        pim, GenerationConfig(max_new_tokens=6), fault_injector=inj,
+        resilience=ResilienceConfig(retry=RetryPolicy(max_retries=4,
+                                                      backoff_s=0.0))))
+    got = rm.generate([prompt])[0]
+    assert inj.injected == 2, "hop faults did not fire"
+    assert got == want

@@ -72,6 +72,7 @@ def test_arrival_records_are_complete_and_ordered():
     rm = RequestManager(im, GenerationConfig(max_new_tokens=4))
     records = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
     for rec in records.values():
+        assert rec["outcome"] == "ok"  # terminal outcome always emitted
         assert rec["arrival_s"] <= rec["admitted_s"]
         assert rec["admitted_s"] < rec["first_token_s"] <= rec["finish_s"]
         assert len(rec["tokens"]) == 4
@@ -124,3 +125,4 @@ def test_under_load_metrics_helper():
     assert m["queue_wait_p50_ms"] is not None
     assert m["queue_wait_p50_ms"] <= m["ttft_p50_ms"]
     assert m["prefill_p50_ms"] is not None
+    assert m["outcomes"] == {"ok": 6}

@@ -34,9 +34,10 @@ def test_dry_run_observability_roundtrips_through_trace_report(tmp_path):
     assert os.path.exists(jsonl)
     assert os.path.exists(obs["paths"]["trace_json"])
 
-    # the section's summary has real content
+    # the section's summary has real content (6 plain requests + the
+    # resilience trio: rejected / preempted-then-finished / cancelled)
     s = obs["summary"]
-    assert s["requests"] == 6 and s["completed"] == 6
+    assert s["requests"] == 9 and s["completed"] == 7
     assert s["ttft_p50_ms"] is not None
     assert s["ttft_p50_ms"] <= s["ttft_p95_ms"]
     assert s["tpot_p50_ms"] is not None
@@ -47,8 +48,20 @@ def test_dry_run_observability_roundtrips_through_trace_report(tmp_path):
     assert abs(err["error_frac"] - 0.1) < 1e-9
     assert any(k.startswith("stage") for k in s["span_ms_by_track"])
 
+    # resilient-serving outcomes + counters round-trip through the JSONL
+    assert s["outcomes"] == {"ok": 7, "rejected": 1, "cancelled": 1}
+    assert s["preemptions"] == 1
+    assert s["dispatch_retries"] == 1 and s["dispatch_faults"] == 1
+    assert s["robustness"]["requests_rejected"] == 1
+    assert s["robustness"]["requests_preempted"] == 1
+    assert s["robustness"]["recompute_tokens"] == 43
+    res = obs["serving_resilience"]["counters"]
+    assert res["requests_rejected"] == 1
+    assert res["requests_cancelled"] == 1
+    assert res["dispatch_retries"] == 1
+
     # metrics snapshot rode along
-    assert obs["metrics"]["requests_finished"] == 6
+    assert obs["metrics"]["requests_finished"] == 7
 
     # the CLI reproduces the summary from the JSONL alone
     reported = json.loads(_run(
