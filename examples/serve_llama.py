@@ -45,6 +45,12 @@ def main():
                          "serve run in a fresh timestamped dir under "
                          "artifacts/profile/, with the telemetry JSON "
                          "exported alongside it")
+    ap.add_argument("--calibration-store", default="",
+                    help="commit this run's predicted-vs-measured ledger "
+                         "into a persisted CalibrationStore JSON (pass a "
+                         "path, or 'default' for the repo artifact "
+                         "artifacts/calibration_store.json) — later "
+                         "search_serve_plan calls auto-apply the scales")
     ap.add_argument("--telemetry-out", default="",
                     help="export the serving telemetry (Perfetto trace "
                          "JSON + JSONL) to this directory (default: the "
@@ -159,6 +165,21 @@ def main():
     if args.pp > 1 and tel.calibration:
         print("predicted-vs-measured:",
               tel.calibration.report()["plans"].get(plan_key))
+    if args.calibration_store and tel.calibration:
+        # the continuous-calibration write path: this measured run's
+        # suggested scales EWMA-blend into the persisted store the next
+        # search_serve_plan(calibration="auto") consults
+        from flexflow_tpu.obs import DEFAULT_STORE_PATH, CalibrationStore
+
+        spath = (DEFAULT_STORE_PATH
+                 if args.calibration_store == "default"
+                 else args.calibration_store)
+        store = CalibrationStore.load(spath)
+        view = tel.calibration.commit(store)
+        store.save()
+        tel.store = store
+        print(f"calibration store updated: {spath} "
+              f"({ {k: v['scale'] for k, v in view.items()} })")
     out_dir = out_dir or prof_dir
     if out_dir:
         paths = tel.export(out_dir, prefix="serve")

@@ -8,7 +8,18 @@ only by construction: telemetry never enters a jitted program, so serve
 outputs are bit-identical with it on or off.  See README "Observability".
 """
 
-from .calibration import CalibrationLedger
+from .calibration import (
+    DEFAULT_STORE_PATH,
+    CalibrationLedger,
+    CalibrationStore,
+    StoreConfig,
+)
+from .drift import (
+    DriftDetector,
+    WorkloadProfile,
+    drift_score,
+    psi,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -16,7 +27,13 @@ from .metrics import (
     MetricsRegistry,
     percentile,
 )
-from .report import summarize_events, summarize_jsonl, under_load_summary
+from .plan_health import PlanHealthConfig, PlanHealthMonitor
+from .report import (
+    summarize_events,
+    summarize_jsonl,
+    under_load_summary,
+    validate_jsonl,
+)
 from .telemetry import (
     NULL_TELEMETRY,
     NullTelemetry,
@@ -37,7 +54,17 @@ __all__ = [
     "Histogram",
     "percentile",
     "CalibrationLedger",
+    "CalibrationStore",
+    "StoreConfig",
+    "DEFAULT_STORE_PATH",
+    "WorkloadProfile",
+    "DriftDetector",
+    "drift_score",
+    "psi",
+    "PlanHealthConfig",
+    "PlanHealthMonitor",
     "summarize_events",
     "summarize_jsonl",
     "under_load_summary",
+    "validate_jsonl",
 ]

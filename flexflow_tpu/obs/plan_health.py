@@ -1,0 +1,189 @@
+"""Plan-health monitoring: is the executing serve plan still the right one?
+
+Closes the last third of the observe->calibrate->re-plan loop (ISSUE 6 /
+ROADMAP "online re-planning"): the search predicted a TPOT/TTFT for the
+plan it picked, the operator has SLO targets, and the plan was priced for
+one workload profile — this monitor watches all three and, when any
+breaks, re-runs the serve search on the DRIFTED profile and emits a
+``replan_recommended`` instant carrying the candidate plan.
+
+**Recommendation-only by design (this PR).**  The monitor never touches
+the executing engine: live migration needs the r9 preemption-and-recompute
+path to drain/move requests and rides a later PR.  Everything here is
+host-side arithmetic over the metrics registry and the workload profile —
+attaching a monitor cannot change serve outputs (bit-identity pinned in
+tests/test_plan_health.py, including a pp2 virtual-mesh config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from .drift import DriftDetector
+from .telemetry import telemetry_or_null
+
+
+@dataclasses.dataclass
+class PlanHealthConfig:
+    """Thresholds for the three health checks.
+
+    * SLO targets (``slo_ttft_p95_s`` / ``slo_tpot_p95_s``): None disables
+      that check — not every deployment has an explicit SLO.
+    * ``max_tpot_error_frac``: tolerated |measured - predicted| / predicted
+      on the plan's own TPOT prediction before the cost model is declared
+      out of touch with reality (the calibration loop should be shrinking
+      this; a breach means the search ranked candidates with a broken
+      ruler).
+    * ``drift_threshold`` / ``drift_min_samples``: forwarded to the
+      :class:`~flexflow_tpu.obs.drift.DriftDetector` (PSI units: >0.25 is
+      the classic "population has shifted" line).
+    * ``min_requests``: finished requests before latency checks engage —
+      percentile comparisons over a handful of requests are noise.
+    """
+
+    slo_ttft_p95_s: Optional[float] = None
+    slo_tpot_p95_s: Optional[float] = None
+    max_tpot_error_frac: float = 0.5
+    drift_threshold: float = 0.25
+    drift_min_samples: int = 16
+    min_requests: int = 8
+
+
+class PlanHealthMonitor:
+    """Compare live latencies/traffic against the executing plan.
+
+    ``plan``: the dict ``search_serve_plan`` returned for the incumbent
+    (``plan_key`` + predicted ``tpot_ms``/``ttft_ms`` are read).
+    ``reference``: the workload-profile snapshot the plan was searched for
+    (default: the telemetry handle's CURRENT window — capture the monitor
+    right after planning so "reference" really is the planned-for mix).
+    ``search_fn``: 0-arg callable re-running the serve search on the LIVE
+    profile, returning a plan dict — injected so hermetic tests (and
+    deployments with custom search wiring) control it; None degrades to
+    report-only health checks.
+
+    :meth:`check` returns the health report and, when any check fails AND
+    the re-search returns a plan whose key differs from the incumbent,
+    emits ``replan_recommended`` (once per distinct candidate while the
+    condition persists — a monitor polled every few ticks must not spam
+    the ring with identical recommendations).
+    """
+
+    def __init__(self, telemetry, plan: Dict, reference=None,
+                 config: Optional[PlanHealthConfig] = None,
+                 search_fn: Optional[Callable[[], Dict]] = None):
+        # None degrades to the no-op handle: checks still run (drift
+        # against an empty window, latencies unavailable), nothing emits
+        self.telemetry = telemetry_or_null(telemetry)
+        self.plan = dict(plan)
+        self.config = config or PlanHealthConfig()
+        if reference is None and self.telemetry.enabled:
+            reference = self.telemetry.workload.snapshot()
+        self.detector = DriftDetector(
+            reference or {"dims": {}},
+            threshold=self.config.drift_threshold,
+            min_samples=self.config.drift_min_samples)
+        self.search_fn = search_fn
+        self.checks = 0
+        self.recommendation: Optional[Dict] = None
+        self._last_candidate_key: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def _hist(self, name: str) -> Dict:
+        snap = self.telemetry.metrics.histogram(name).snapshot() \
+            if self.telemetry.enabled else {}
+        return snap or {}
+
+    def check(self) -> Dict:
+        """One health evaluation: latency vs prediction, latency vs SLO,
+        live workload vs reference.  Host-side only."""
+        cfg = self.config
+        tel = self.telemetry
+        self.checks += 1
+        plan_key = self.plan.get("plan_key", "?")
+        report: Dict = {"plan": plan_key, "checks": self.checks,
+                        "reasons": []}
+        reasons = report["reasons"]
+
+        ttft = self._hist("ttft_s")
+        tpot = self._hist("tpot_s")
+        enough = (tpot.get("count") or 0) >= cfg.min_requests
+
+        # 1. predicted-vs-measured TPOT (the plan's own fidelity)
+        pred_tpot_s = (self.plan.get("tpot_ms") or 0.0) / 1e3
+        meas_tpot_s = tpot.get("p50")
+        report["tpot_predicted_ms"] = round(pred_tpot_s * 1e3, 4)
+        report["tpot_measured_p50_ms"] = (
+            round(meas_tpot_s * 1e3, 4) if meas_tpot_s is not None else None)
+        if enough and pred_tpot_s > 0 and meas_tpot_s is not None:
+            err = (meas_tpot_s - pred_tpot_s) / pred_tpot_s
+            report["tpot_error_frac"] = round(err, 4)
+            if tel.enabled:
+                tel.metrics.gauge("plan_tpot_error_frac").set(err)
+            if abs(err) > cfg.max_tpot_error_frac:
+                reasons.append("prediction_error")
+
+        # 2. SLO targets on the live p95s
+        if enough and cfg.slo_ttft_p95_s is not None \
+                and ttft.get("p95") is not None \
+                and ttft["p95"] > cfg.slo_ttft_p95_s:
+            report["ttft_p95_s"] = round(ttft["p95"], 6)
+            reasons.append("slo_ttft")
+        if enough and cfg.slo_tpot_p95_s is not None \
+                and tpot.get("p95") is not None \
+                and tpot["p95"] > cfg.slo_tpot_p95_s:
+            report["tpot_p95_s"] = round(tpot["p95"], 6)
+            reasons.append("slo_tpot")
+
+        # 3. workload drift vs the planned-for reference
+        drift = self.detector.check(
+            tel.workload if tel.enabled else {"dims": {}},
+            telemetry=tel)
+        report["drift"] = drift
+        if drift["drifted"]:
+            reasons.append("workload_drift")
+
+        report["healthy"] = not reasons
+        if tel.enabled:
+            tel.metrics.gauge("plan_health_ok").set(0.0 if reasons else 1.0)
+
+        # 4. unhealthy -> re-search on the live profile (recommendation
+        # only; the candidate must actually differ to be worth emitting)
+        if reasons and self.search_fn is not None:
+            try:
+                candidate = self.search_fn()
+            except Exception as e:  # a failed re-search must not kill serving
+                report["replan_error"] = f"{type(e).__name__}: {e}"[:120]
+                candidate = None
+            if candidate is not None:
+                cand_key = candidate.get("plan_key", "?")
+                report["candidate"] = {
+                    "plan_key": cand_key,
+                    "tpot_ms": candidate.get("tpot_ms"),
+                    "ttft_ms": candidate.get("ttft_ms"),
+                }
+                if cand_key != plan_key:
+                    self.recommendation = {
+                        "incumbent": plan_key, "candidate": cand_key,
+                        "reasons": list(reasons),
+                        "candidate_tpot_ms": candidate.get("tpot_ms"),
+                        "drift_score": drift["score"],
+                    }
+                    report["replan_recommended"] = True
+                    if tel.enabled and cand_key != self._last_candidate_key:
+                        tel.instant(
+                            "replan_recommended", cat="plan",
+                            track="plan_health",
+                            incumbent=plan_key, candidate=cand_key,
+                            reasons=",".join(reasons),
+                            candidate_tpot_ms=candidate.get("tpot_ms"),
+                            drift_score=drift["score"])
+                        tel.metrics.counter("replans_recommended").inc()
+                    self._last_candidate_key = cand_key
+                else:
+                    report["incumbent_reaffirmed"] = True
+        if not reasons:
+            # condition cleared: a future excursion may re-emit
+            self._last_candidate_key = None
+        return report

@@ -35,6 +35,9 @@ _TERMINAL_EVENTS = {
 _PREEMPT = "request_preempt"
 _RETRY = "dispatch_retry"
 _FAULT = "dispatch_fault"
+# observe->calibrate->re-plan loop events (obs/drift.py, obs/plan_health.py)
+_DRIFT = "drift_detected"
+_REPLAN = "replan_recommended"
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -56,6 +59,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     track_names: Dict[int, str] = {}
     outcomes: Dict[str, int] = {}
     preemptions = retries = faults = 0
+    drift_events: List[Dict] = []
+    replans: List[Dict] = []
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -72,6 +77,12 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _FAULT:
             faults += 1
+            continue
+        if name == _DRIFT:
+            drift_events.append(ev.get("args", {}))
+            continue
+        if name == _REPLAN:
+            replans.append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -130,6 +141,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         "preemptions": preemptions,
         "dispatch_retries": retries,
         "dispatch_faults": faults,
+        # plan feedback loop: drift excursions + replan recommendations
+        "drift_detected": drift_events,
+        "replan_recommended": replans,
     }
 
 
@@ -140,6 +154,8 @@ def summarize_jsonl(path: str) -> Dict:
     meta: Dict = {}
     metrics: Dict = {}
     calibration: Dict = {}
+    workload: Dict = {}
+    store: Dict = {}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -155,11 +171,25 @@ def summarize_jsonl(path: str) -> Dict:
                 metrics = doc.get("snapshot", {})
             elif kind == "calibration":
                 calibration = doc.get("report", {})
+            elif kind == "workload":
+                workload = doc.get("snapshot", {})
+            elif kind == "calibration_store":
+                store = doc
 
     summary = summarize_events(events)
     summary["events"] = meta.get("events", len(events))
     summary["dropped"] = meta.get("dropped", 0)
     summary["bubble_frac"] = metrics.get("pp_bubble_frac")
+    # plan feedback loop: live drift score (gauge = last value), the
+    # workload window the handle accumulated, and the persisted scales the
+    # next search will auto-apply
+    summary["workload_drift_score"] = metrics.get("workload_drift_score")
+    summary["workload"] = {
+        d: {"n": w.get("n"), "mean": (round(w["mean"], 4)
+                                      if w.get("mean") is not None else None)}
+        for d, w in sorted(workload.get("dims", {}).items())
+        if w.get("n")}
+    summary["applied_scales"] = store.get("applied_scales", {})
     # registry view of the resilience counters (the trace ring can drop
     # events under pressure; the counters are exact)
     from .telemetry import RESILIENCE_COUNTERS
@@ -177,6 +207,111 @@ def summarize_jsonl(path: str) -> Dict:
     summary["prediction_error"] = pred_err
     summary["calibration_components"] = calibration.get("components", {})
     return summary
+
+
+# JSONL line kinds Telemetry.export writes -> fields each must carry
+_REQUIRED_BY_KIND = {
+    "telemetry_meta": ("version", "ts_unit", "events", "dropped"),
+    "event": (),                      # per-phase rules below
+    "metrics": ("snapshot",),
+    "calibration": ("report",),
+    "workload": ("snapshot",),
+    "calibration_store": ("components", "applied_scales"),
+}
+
+
+def validate_jsonl(path: str) -> List[str]:
+    """Validate a ``Telemetry.export`` JSONL against the event schema.
+
+    Returns the list of violations (empty = valid).  The contract checked
+    is exactly what :func:`summarize_jsonl` consumes: known line kinds
+    with their required fields, well-formed trace events per phase, and —
+    for the typed ``request``/``dispatch``/``plan`` categories — names and
+    required args from ``telemetry.EVENT_SCHEMA``, the single vocabulary
+    the emitters share.  ``bench.py --dry-run``'s export is validated by a
+    tier-1 test, so the bench-side emitters and this parser cannot drift
+    apart silently (``scripts/trace_report.py --check`` is the CLI).
+
+    Free-form spans/counters on other categories are NOT constrained —
+    instrumentation may add tracks freely; only the typed vocabulary is
+    load-bearing for the report.
+    """
+    from .telemetry import EVENT_SCHEMA
+
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"unreadable: {e}"]
+    if not lines:
+        return ["empty file"]
+
+    def err(i, msg):
+        if len(errors) < 100:  # bounded output on pathological files
+            errors.append(f"line {i}: {msg}")
+
+    saw_meta = False
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError as e:
+            err(i, f"not JSON: {e}")
+            continue
+        kind = doc.get("kind")
+        if kind not in _REQUIRED_BY_KIND:
+            err(i, f"unknown kind {kind!r}")
+            continue
+        missing = [k for k in _REQUIRED_BY_KIND[kind] if k not in doc]
+        if missing:
+            err(i, f"{kind} missing fields {missing}")
+        if kind == "telemetry_meta":
+            saw_meta = True
+            continue
+        if kind != "event":
+            continue
+        # trace-event phase rules
+        ph = doc.get("ph")
+        base_missing = [k for k in ("name", "ph", "pid", "tid")
+                        if k not in doc]
+        if base_missing:
+            err(i, f"event missing fields {base_missing}")
+            continue
+        if ph not in ("M", "X", "i", "C"):
+            err(i, f"unknown event phase {ph!r}")
+            continue
+        if ph == "M":
+            if doc.get("name") != "thread_name" \
+                    or "name" not in doc.get("args", {}):
+                err(i, "metadata event must be thread_name with args.name")
+            continue
+        if "ts" not in doc:
+            err(i, f"{ph!r} event missing ts")
+        if ph == "X" and "dur" not in doc:
+            err(i, "complete span missing dur")
+        if ph == "C" and "value" not in doc.get("args", {}):
+            err(i, "counter event missing args.value")
+        # typed vocabulary: the categories the report parses semantically
+        cat = doc.get("cat")
+        if ph == "i" and cat in ("request", "dispatch", "plan"):
+            name = doc["name"]
+            schema = EVENT_SCHEMA.get(name)
+            if schema is None:
+                err(i, f"unknown {cat} event {name!r}")
+                continue
+            want_cat, want_args = schema
+            if cat != want_cat:
+                err(i, f"{name} has cat {cat!r}, schema says {want_cat!r}")
+            args = doc.get("args", {})
+            missing = [a for a in want_args if a not in args]
+            if missing:
+                err(i, f"{name} missing args {missing}")
+    if not saw_meta:
+        errors.insert(0, "no telemetry_meta line")
+    return errors
 
 
 def under_load_summary(records: Dict, makespan_s: Optional[float] = None

@@ -36,6 +36,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from .calibration import CalibrationLedger
+from .drift import WorkloadProfile
 from .metrics import MetricsRegistry
 from .trace import TraceRecorder
 
@@ -49,16 +50,49 @@ RESILIENCE_COUNTERS = (
     "dispatch_retries", "dispatch_faults",
 )
 
+# the typed-instant event schema: name -> (category, required arg keys).
+# Telemetry's methods emit exactly these; report.summarize_jsonl parses
+# them; scripts/trace_report.py --check validates exported JSONLs against
+# THIS table — adding a lifecycle/plan event means adding a row here, so
+# the three cannot drift apart (satellite of ISSUE 6: bench and
+# trace_report schemas can never diverge silently).
+EVENT_SCHEMA = {
+    "request_enqueue": ("request", ("trace_id",)),
+    "request_admit": ("request", ("trace_id",)),
+    "request_prefill_start": ("request", ("trace_id",)),
+    "request_first_token": ("request", ("trace_id",)),
+    "request_finish": ("request", ("trace_id", "n_tokens")),
+    "request_reject": ("request", ("trace_id",)),
+    "request_cancel": ("request", ("trace_id",)),
+    "request_timeout": ("request", ("trace_id",)),
+    "request_preempt": ("request", ("trace_id",)),
+    "request_fail": ("request", ("trace_id",)),
+    "dispatch_retry": ("dispatch", ("site", "attempt")),
+    "dispatch_fault": ("dispatch", ("site",)),
+    # the observe->calibrate->re-plan loop (obs/drift.py, obs/plan_health.py)
+    "drift_detected": ("plan", ("score",)),
+    "replan_recommended": ("plan", ("incumbent", "candidate")),
+}
+
 
 class Telemetry:
     enabled = True
 
     def __init__(self, capacity: int = 65536,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 workload_window: int = 512):
         self._clock = clock or time.perf_counter
         self.trace = TraceRecorder(capacity=capacity, clock=self._clock)
         self.metrics = MetricsRegistry()
         self.calibration = CalibrationLedger()
+        # windowed traffic-mix characterization, fed by the request_* /
+        # batch_composition / spec_acceptance calls below — the live side
+        # of drift detection (obs/drift.py).  It reuses the trace events'
+        # timestamps, so enabling it costs no extra clock reads.
+        self.workload = WorkloadProfile(window=workload_window)
+        # optional persisted CalibrationStore: attach one to have export()
+        # write its applied scales alongside the ledger report
+        self.store = None
 
     # ---- primitive delegation -----------------------------------------
     def now(self) -> float:
@@ -80,8 +114,10 @@ class Telemetry:
     # ---- serving lifecycle (see module docstring) ---------------------
     def request_enqueued(self, trace_id: str, prompt_len: int = 0) -> float:
         self.metrics.counter("requests_enqueued").inc()
-        return self.trace.instant("request_enqueue", "request", "requests",
-                                  trace_id=trace_id, prompt_len=prompt_len)
+        ts = self.trace.instant("request_enqueue", "request", "requests",
+                                trace_id=trace_id, prompt_len=prompt_len)
+        self.workload.observe_enqueue(prompt_len, ts=ts)
+        return ts
 
     def request_admitted(self, trace_id: str,
                          queue_wait_s: Optional[float] = None) -> float:
@@ -110,6 +146,7 @@ class Telemetry:
         self.metrics.counter("tokens_generated").inc(n_tokens)
         if tpot_s is not None:
             self.metrics.histogram("tpot_s").observe(tpot_s)
+        self.workload.observe_finish(n_tokens)
         return self.trace.instant("request_finish", "request", "requests",
                                   trace_id=trace_id, n_tokens=n_tokens,
                                   tpot_s=tpot_s)
@@ -171,8 +208,23 @@ class Telemetry:
         util = kv_tokens / kv_capacity if kv_capacity else 0.0
         m.gauge("batch_slot_occupancy").set(occ)
         m.gauge("kv_cache_utilization").set(util)
+        self.workload.observe_occupancy(occ)
         self.trace.counter("batch_slot_occupancy", occ)
         self.trace.counter("kv_cache_utilization", util)
+
+    def spec_acceptance(self, accepted: int, drafted: int) -> float:
+        """One speculative verify round's accept result for a request:
+        ``accepted`` of ``drafted`` tree tokens survived the walk.  Feeds
+        the acceptance-rate histogram the workload profile tracks (spec
+        pricing is acceptance-sensitive) and the cumulative counters.
+        Returns the acceptance fraction."""
+        frac = accepted / drafted if drafted > 0 else 0.0
+        m = self.metrics
+        m.counter("spec_tokens_drafted").inc(drafted)
+        m.counter("spec_tokens_accepted").inc(accepted)
+        m.histogram("spec_acceptance_frac").observe(frac)
+        self.workload.observe_spec_acceptance(frac)
+        return frac
 
     # ---- predicted-vs-measured ----------------------------------------
     def record_plan_prediction(self, plan_key: str, **fields) -> None:
@@ -187,6 +239,7 @@ class Telemetry:
         return {
             "metrics": self.metrics.snapshot(),
             "calibration": self.calibration.report(),
+            "workload": self.workload.features(),
             "trace": {"events": self.trace.emitted,
                       "dropped": self.trace.dropped},
         }
@@ -215,6 +268,15 @@ class Telemetry:
                                 "snapshot": self.metrics.snapshot()}) + "\n")
             f.write(json.dumps({"kind": "calibration",
                                 "report": self.calibration.report()}) + "\n")
+            f.write(json.dumps({"kind": "workload",
+                                "snapshot": self.workload.snapshot()}) + "\n")
+            if self.store is not None:
+                f.write(json.dumps({"kind": "calibration_store",
+                                    "path": self.store.path,
+                                    "components": self.store.as_dict()
+                                    ["components"],
+                                    "applied_scales": self.store.scales()})
+                        + "\n")
         return {"trace_json": trace_path, "jsonl": jsonl_path}
 
 
@@ -287,6 +349,9 @@ class NullTelemetry:
 
     def batch_composition(self, *a, **k):
         return None
+
+    def spec_acceptance(self, *a, **k):
+        return 0.0
 
     def record_plan_prediction(self, *a, **k):
         return None

@@ -148,8 +148,20 @@ class TraceRecorder:
         return out
 
     def to_chrome_json(self) -> Dict:
-        """The ``chrome://tracing`` / Perfetto-loadable document."""
-        return {"traceEvents": self.trace_events(), "displayTimeUnit": "ms"}
+        """The ``chrome://tracing`` / Perfetto-loadable document.
+
+        ``metadata`` carries the ring accounting (lifetime ``emitted`` vs
+        ``dropped``): a trace whose oldest events fell off the ring must
+        not masquerade as a complete record — viewers ignore the extra
+        top-level key, ``scripts/trace_report.py`` warns on it.
+        """
+        return {
+            "traceEvents": self.trace_events(),
+            "displayTimeUnit": "ms",
+            "metadata": {"trace_events_emitted": self.emitted,
+                         "trace_events_dropped": self.dropped,
+                         "ring_capacity": self.capacity},
+        }
 
     def export_json(self, path: str) -> str:
         with open(path, "w") as f:

@@ -113,6 +113,48 @@ class MachineModel:
         )
         return MachineModel(spec, self.dcn_axes)
 
+    # spec constants a CalibrationStore may scale, by dimensional sense:
+    # a measured/predicted TIME ratio > 1 means the machine is slower than
+    # modeled -> time-like constants multiply by the scale, rate-like
+    # constants divide by it
+    _TIME_CONSTANTS = frozenset({
+        "step_overhead", "kernel_overhead", "ici_latency", "dcn_latency",
+        "train_step_factor",
+    })
+    _RATE_CONSTANTS = frozenset({
+        "hbm_bandwidth", "ici_bandwidth", "dcn_bandwidth",
+        "peak_flops_bf16", "peak_flops_f32", "mxu_efficiency",
+    })
+
+    def with_store(self, store) -> "MachineModel":
+        """Return a copy whose spec constants are corrected by a persisted
+        :class:`~flexflow_tpu.obs.calibration.CalibrationStore`.
+
+        Only store components NAMED after a spec constant apply here
+        (``step_overhead``, ``mxu_efficiency``, ...); field-level
+        components (``tpot_ms``, ``transfer_ms``, ...) are consumed by
+        ``search_serve_plan`` at the prediction layer instead.  Scales
+        below the store's min-sample gate are ignored (``scale_for``
+        returns 1.0), and an empty/None store returns ``self`` unchanged —
+        so this COMPOSES with :meth:`with_calibration`: measured constants
+        load first, the store's cross-run drift corrections stack
+        multiplicatively on top, and neither clobbers the other
+        (pinned by tests/test_calibration_loop.py).
+        """
+        if store is None:
+            return self
+        updates = {}
+        for name in self._TIME_CONSTANTS | self._RATE_CONSTANTS:
+            s = store.scale_for(name)
+            if s == 1.0:
+                continue
+            v = getattr(self.spec, name)
+            updates[name] = v * s if name in self._TIME_CONSTANTS else v / s
+        if not updates:
+            return self
+        return MachineModel(dataclasses.replace(self.spec, **updates),
+                            self.dcn_axes)
+
     # ---- compute ------------------------------------------------------
     def compute_time(self, flops: float, bytes_accessed: float,
                      dtype_bits: int = 32) -> float:

@@ -54,7 +54,11 @@ def _stage_kv_bytes(plan) -> float:
 
 
 def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
-                  boundary_bytes: float = 0.0, pp_axes=()) -> Dict:
+                  boundary_bytes: float = 0.0, pp_axes=(),
+                  kv_fill_frac: float = 1.0,
+                  prefill_tok_per_s: float = 0.0,
+                  prompt_len: float = 0.0,
+                  batch_rows: int = 0) -> Dict:
     """Simulated STEADY-STATE decode cost for a stage-split serve plan.
 
     The graph's flat batch (``R_tot`` concurrent decode slots) splits into
@@ -77,10 +81,34 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
       ``(S-1)`` extra ticks once per scan, amortized over its length
       (not counted here).
 
-    Returns ``{tpot_s, tick_s, bubble_frac, transfer_s, stage_ticks}``.
+    Workload-aware terms (ISSUE 6: price the plan for the TRAFFIC MIX,
+    not just the graph) — all default-off, so a workload-less call prices
+    exactly as before:
+
+    * ``kv_fill_frac`` scales the committed-KV streaming term: the cache
+      read bound is the CAUSALLY LIVE prefix, which the live traffic's
+      mean sequence length and occupancy determine (1.0 keeps the
+      err-high full-capacity bound).
+    * ``prefill_tok_per_s`` (with ``batch_rows``, the flat token batch the
+      stage flops were priced at) models prefill INTERFERENCE on steady-
+      state decode: arriving prompts eat ``rho`` of the bottleneck
+      stage's compute time, inflating effective TPOT by ``1/(1-rho)``.
+      Sharding the model (tp or pp) shrinks each chip's share of that
+      prefill work through the per-stage flops themselves.
+    * ``prompt_len`` adds a TTFT estimate: one request's prefill crosses
+      the stages SEQUENTIALLY (pipelining overlaps chunks of different
+      requests, not one request's first token), so pp buys TTFT nothing —
+      while tp divides the prefill compute per chip.  The classic
+      TTFT-vs-TPOT asymmetry that makes the best plan workload-dependent.
+
+    Returns ``{tpot_s, tick_s, bubble_frac, transfer_s, stage_ticks,
+    prefill_util, ttft_s}`` (``ttft_s`` None unless ``prompt_len`` given).
     """
     spec = machine.spec
+    peak = spec.peak_flops_bf16 * spec.mxu_efficiency
     ticks: List[float] = []
+    stage_fl: List[float] = []
+    stage_w: List[float] = []
     for plan in stage_plans:
         mesh = plan.mesh
         w = fl = comm = 0.0
@@ -95,25 +123,48 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
             w += _step_param_bytes(step, plan, mesh)
             if step.node.op.type_name in HEAVY_OPS:
                 fl += _step_flops(step, mesh)
-        kv = _stage_kv_bytes(plan)
+        kv = _stage_kv_bytes(plan) * kv_fill_frac
         tick = (
             w / spec.hbm_bandwidth
-            + (fl / (spec.peak_flops_bf16 * spec.mxu_efficiency)
-               + kv / spec.hbm_bandwidth + comm) / n_micro
+            + (fl / peak + kv / spec.hbm_bandwidth + comm) / n_micro
             + spec.step_overhead
         )
         ticks.append(tick)
+        stage_fl.append(fl)
+        stage_w.append(w)
     s = len(stage_plans)
     hop = machine.transfer_time(boundary_bytes / max(n_micro, 1), pp_axes) \
         if s > 1 else 0.0
     tick = max(ticks) + hop
     tpot = max(n_micro, s) * tick
+
+    rho = 0.0
+    if prefill_tok_per_s > 0 and batch_rows > 0:
+        # bottleneck stage's prefill duty cycle; capped so an offered load
+        # past saturation prices as "very bad", not divide-by-zero
+        tok_s = max(stage_fl) / batch_rows / peak
+        rho = min(prefill_tok_per_s * tok_s, 0.95)
+        tpot = tpot / (1.0 - rho)
+
+    ttft = None
+    if prompt_len > 0 and batch_rows > 0:
+        # serial pass over the stages: per stage, compute overlaps that
+        # stage's one-time weight stream (max of the two), plus the
+        # boundary hops and per-stage dispatch overhead
+        ttft = sum(
+            max(prompt_len * fl_i / batch_rows / peak,
+                w_i / spec.hbm_bandwidth)
+            for fl_i, w_i in zip(stage_fl, stage_w)
+        ) + (s - 1) * hop + s * spec.step_overhead
+
     return {
         "tpot_s": tpot,
         "tick_s": tick,
         "bubble_frac": max(0, s - n_micro) / s,
         "transfer_s": hop,
         "stage_ticks": ticks,
+        "prefill_util": round(rho, 4),
+        "ttft_s": ttft,
     }
 
 
@@ -132,6 +183,82 @@ def _boundary_bytes(graph, split) -> float:
     return worst
 
 
+def _workload_features(workload) -> Optional[Dict[str, float]]:
+    """Normalize a workload argument to the plan-facing feature scalars:
+    a :class:`~flexflow_tpu.obs.drift.WorkloadProfile`, a features dict,
+    or None."""
+    if workload is None:
+        return None
+    if hasattr(workload, "features"):
+        return dict(workload.features())
+    if isinstance(workload, dict):
+        return dict(workload)
+    raise TypeError(f"workload must be a WorkloadProfile or features dict, "
+                    f"got {type(workload).__name__}")
+
+
+def _resolve_store(calibration):
+    """Resolve the ``calibration`` argument to a CalibrationStore or None.
+
+    ``"auto"`` (the default) loads the repo's persisted store artifact
+    when one exists — the continuous-calibration read path: a store
+    committed after a measured run steers every later search with no
+    extra plumbing.  ``None``/``False`` disables; a path string or store
+    instance is used as given.  An empty store is returned as None (no
+    scales to apply).
+    """
+    from ..obs.calibration import CalibrationStore, default_store_path
+
+    if calibration is None or calibration is False:
+        return None
+    if isinstance(calibration, CalibrationStore):
+        return calibration if calibration else None
+    if calibration == "auto":
+        import os
+
+        calibration = default_store_path()
+        if calibration is None or not os.path.exists(calibration):
+            return None
+    store = CalibrationStore.load(str(calibration))
+    return store if store else None
+
+
+def _workload_knobs(feats: Optional[Dict], max_seq) -> Dict[str, float]:
+    """Feature scalars -> the :func:`pp_serve_cost` pricing knobs — ONE
+    derivation shared by :func:`search_serve_plan` and :func:`price_plan`,
+    so the chooser and the replay/measured side price a workload
+    identically (a modeling gap between them would launder into the
+    calibration store as fake machine skew)."""
+    knobs = {"kv_fill_frac": 1.0, "prefill_tok_per_s": 0.0,
+             "prompt_len": 0.0, "out_len": 0.0}
+    if not feats:
+        return knobs
+    prompt_len = float(feats.get("mean_prompt_len", 0.0) or 0.0)
+    out_len = float(feats.get("mean_output_len", 0.0) or 0.0)
+    rate = float(feats.get("arrival_rate_per_s", 0.0) or 0.0)
+    occ = float(feats.get("mean_occupancy", 1.0) or 1.0)
+    knobs["prompt_len"] = prompt_len
+    knobs["out_len"] = out_len
+    knobs["prefill_tok_per_s"] = rate * prompt_len
+    if max_seq:
+        # mean causally-live depth per slot: the whole prompt plus half
+        # the output (tokens accrue linearly over a decode); a cold
+        # profile (0 fill) keeps the err-high full-capacity bound
+        knobs["kv_fill_frac"] = min(
+            1.0, max(occ * (prompt_len + 0.5 * out_len) / max_seq, 0.0)
+        ) or 1.0
+    return knobs
+
+
+def _graph_rows(graph, attn_node) -> int:
+    """The flat token-batch rows the serve graph was built for
+    (``max_tokens_per_batch``): the attention input's leading dim."""
+    try:
+        return int(graph.spec(attn_node.inputs[0]).shape[0])
+    except Exception:
+        return 0
+
+
 def search_serve_plan(
     model,
     n_chips: int,
@@ -141,6 +268,8 @@ def search_serve_plan(
     devices=None,
     spec_name: Optional[str] = None,
     telemetry=None,
+    workload=None,
+    calibration="auto",
 ) -> Dict:
     """Pick the best (tp, pp, n_micro) for serving ``model``'s graph on
     ``n_chips`` chips.
@@ -150,6 +279,30 @@ def search_serve_plan(
     its calibration ledger under ``tp{t}_pp{p}_m{m}``, so the executing
     side only has to add measured values for the predicted-vs-measured
     report (the MachineModel tuning loop).
+
+    ``workload``: optional traffic-mix features (a
+    :class:`~flexflow_tpu.obs.drift.WorkloadProfile` or its
+    ``features()`` dict).  When given, candidates are priced for THAT
+    traffic: the committed-KV stream scales to the live fill fraction,
+    arriving prompts charge prefill interference on decode, and the
+    ranking objective becomes per-token cost
+    ``tpot + ttft / mean_output_len`` (amortized first-token latency) —
+    so a prompt-heavy mix can flip the winner toward tp (which
+    parallelizes a single prefill) where a decode-heavy mix prefers the
+    lower-TPOT plan.  Without it the ranking is pure steady-state TPOT,
+    exactly as before.
+
+    ``calibration``: the continuous-calibration read path — ``"auto"``
+    (default) consults the persisted
+    :class:`~flexflow_tpu.obs.CalibrationStore` artifact when one exists;
+    a store instance / path / None override.  Store components named
+    after MachineModel constants correct the machine
+    (:meth:`MachineModel.with_store`); field-level components
+    (``tpot_ms``/``ttft_ms``/``transfer_ms``/``memory_gb``) scale the
+    recorded predictions, so the next predicted-vs-measured pair starts
+    from the corrected estimate.  The HBM fits-gate always uses the RAW
+    ``plan_memory_bytes`` — calibration must never un-reject a plan the
+    err-high capacity contract rejected.
 
     The graph must already carry its serve capacities
     (``register_serve_capacities`` — InferenceManager/PipelinedInferenceManager
@@ -180,12 +333,31 @@ def search_serve_plan(
     devices = list(devices if devices is not None else jax.devices())
     kv_heads = None
     n_layers = 0
+    attn0 = None
+    max_seq = None
     for node in graph.nodes:
         if isinstance(node.op, IncMultiHeadSelfAttention):
             kv_heads = node.op.num_kv_heads
+            if attn0 is None:
+                attn0 = node
+                max_seq = getattr(node.op, "cost_seq_len", None)
             n_layers += 1
     if not n_layers:
         raise ValueError("graph has no serve attention ops")
+
+    feats = _workload_features(workload)
+    store = _resolve_store(calibration)
+    rows = _graph_rows(graph, attn0)
+    knobs = _workload_knobs(feats, max_seq)
+    kv_fill = knobs["kv_fill_frac"]
+    prefill_rate = knobs["prefill_tok_per_s"]
+    prompt_len = knobs["prompt_len"]
+    out_len = knobs["out_len"]
+    # field-level calibration scales (1.0 without a store)
+    s_tpot = store.scale_for("tpot_ms") if store else 1.0
+    s_ttft = store.scale_for("ttft_ms") if store else 1.0
+    s_xfer = store.scale_for("transfer_ms") if store else 1.0
+    s_mem = store.scale_for("memory_gb") if store else 1.0
 
     candidates: Dict[str, Dict] = {}
     best = None
@@ -199,6 +371,8 @@ def search_serve_plan(
         # the same tp-wide device slice
         mesh = make_mesh({"tp": tp}, devices[:tp])
         mm = machine or MachineModel.for_mesh(mesh, spec_name=spec_name)
+        if store is not None:
+            mm = mm.with_store(store)
         cap = hbm_cap if hbm_cap is not None else mm.spec.hbm_capacity
         try:
             split = serve_stage_split(graph, pp)
@@ -220,22 +394,43 @@ def search_serve_plan(
             if m < 1:
                 continue
             cost = pp_serve_cost(plans, mm, n_micro=m,
-                                 boundary_bytes=bbytes)
+                                 boundary_bytes=bbytes,
+                                 kv_fill_frac=kv_fill,
+                                 prefill_tok_per_s=prefill_rate,
+                                 prompt_len=prompt_len,
+                                 batch_rows=rows)
+            tpot_s = cost["tpot_s"] * s_tpot
+            ttft_s = (cost["ttft_s"] * s_ttft
+                      if cost["ttft_s"] is not None else None)
+            # ranking objective: per-generated-token cost — amortize the
+            # first token's latency over the expected output length
+            obj = tpot_s
+            if ttft_s is not None and out_len > 0:
+                obj = tpot_s + ttft_s / out_len
             by_m[str(m)] = {
-                "tpot_ms": round(cost["tpot_s"] * 1e3, 4),
+                "tpot_ms": round(tpot_s * 1e3, 4),
                 "bubble_frac": round(cost["bubble_frac"], 4),
-                "transfer_ms": round(cost["transfer_s"] * 1e3, 5),
+                "transfer_ms": round(cost["transfer_s"] * s_xfer * 1e3, 5),
             }
+            if ttft_s is not None:
+                by_m[str(m)]["ttft_ms"] = round(ttft_s * 1e3, 4)
+                by_m[str(m)]["objective_ms"] = round(obj * 1e3, 4)
             if entry["fits"] and (best is None
-                                  or cost["tpot_s"] < best["tpot_s"]):
+                                  or obj < best["objective_s"]):
                 best = {
                     "tp": tp, "pp": pp, "n_micro": m,
-                    "tpot_s": cost["tpot_s"],
-                    "tpot_ms": round(cost["tpot_s"] * 1e3, 4),
+                    "tpot_s": tpot_s,
+                    "objective_s": obj,
+                    "tpot_ms": round(tpot_s * 1e3, 4),
                     "bubble_frac": round(cost["bubble_frac"], 4),
-                    "transfer_ms": round(cost["transfer_s"] * 1e3, 5),
+                    "transfer_ms": round(cost["transfer_s"] * s_xfer
+                                         * 1e3, 5),
+                    "prefill_util": cost["prefill_util"],
                     "per_stage_gb": entry["per_stage_gb"],
                 }
+                if ttft_s is not None:
+                    best["ttft_ms"] = round(ttft_s * 1e3, 4)
+                    best["objective_ms"] = round(obj * 1e3, 4)
         entry["by_micro"] = by_m
         candidates[f"tp{tp}_pp{pp}"] = entry
 
@@ -246,12 +441,70 @@ def search_serve_plan(
         )
     best["candidates"] = candidates
     best["plan_key"] = f"tp{best['tp']}_pp{best['pp']}_m{best['n_micro']}"
+    if feats:
+        best["workload"] = feats
+    if store is not None:
+        best["applied_scales"] = store.scales()
     if telemetry is not None and getattr(telemetry, "enabled", False):
         telemetry.record_plan_prediction(
             best["plan_key"],
             tpot_ms=best["tpot_ms"],
             bubble_frac=best["bubble_frac"],
             transfer_ms=best["transfer_ms"],
-            memory_gb=max(best["per_stage_gb"]),
+            memory_gb=round(max(best["per_stage_gb"]) * s_mem, 4),
+            ttft_ms=best.get("ttft_ms"),
         )
     return best
+
+
+def price_plan(
+    model,
+    tp: int,
+    pp: int,
+    n_micro: int = 1,
+    machine: Optional[MachineModel] = None,
+    devices=None,
+    spec_name: Optional[str] = None,
+    workload=None,
+) -> Dict:
+    """Price ONE tp x pp x m factorization with the same stage-split and
+    cost machinery :func:`search_serve_plan` ranks with.
+
+    The replay/ground-truth half of the calibration loop: given the
+    executing plan's coordinates and a DIFFERENT machine model (e.g. the
+    true constants in a simulation, or re-calibrated ones after a store
+    update), what would the cost model have said?  No memory gate, no
+    calibration store — this prices, it does not choose.
+    """
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    from ..serve.inference_manager import tensor_parallel_strategy
+    from ..serve.ops import IncMultiHeadSelfAttention
+    from ..serve.pp import build_stage_plans, serve_stage_split
+
+    graph = model.graph if hasattr(model, "graph") else model
+    devices = list(devices if devices is not None else jax.devices())
+    mesh = make_mesh({"tp": tp}, devices[:tp])
+    mm = machine or MachineModel.for_mesh(mesh, spec_name=spec_name)
+    split = serve_stage_split(graph, pp)
+    strategy = tensor_parallel_strategy(graph, ("tp",), mesh) \
+        if tp > 1 else {}
+    plans = build_stage_plans(graph, split, strategy, [mesh] * pp)
+    attn0 = next(n for n in graph.nodes
+                 if isinstance(n.op, IncMultiHeadSelfAttention))
+    knobs = _workload_knobs(_workload_features(workload),
+                            getattr(attn0.op, "cost_seq_len", None))
+    knobs.pop("out_len")  # pricing knob only for the ranking objective
+    cost = pp_serve_cost(
+        plans, mm, n_micro=n_micro,
+        boundary_bytes=_boundary_bytes(graph, split),
+        batch_rows=_graph_rows(graph, attn0),
+        **knobs,
+    )
+    cost["plan_key"] = f"tp{tp}_pp{pp}_m{n_micro}"
+    cost["tpot_ms"] = round(cost["tpot_s"] * 1e3, 4)
+    cost["transfer_ms"] = round(cost["transfer_s"] * 1e3, 5)
+    if cost["ttft_s"] is not None:
+        cost["ttft_ms"] = round(cost["ttft_s"] * 1e3, 4)
+    return cost

@@ -69,6 +69,7 @@ class LLM:
         telemetry=None,
         resilience=None,
         fault_injector=None,
+        plan_health=None,
     ) -> "LLM":
         """``kv_dtype="int8"`` stores the KV caches int8 with fused
         in-kernel dequant (see ``InferenceManager``) — halves decode KV
@@ -80,7 +81,11 @@ class LLM:
         observability handle and the resilient-serving policy layer
         (admission control, deadlines/cancellation, preemption-and-
         recompute, dispatch retry — see ``serve/resilience.py``) into the
-        RequestManager."""
+        RequestManager.  ``plan_health`` attaches a
+        :class:`~flexflow_tpu.obs.PlanHealthMonitor` the serve loops poll
+        (SLO / prediction-error / workload-drift checks emitting
+        ``replan_recommended`` — recommendation-only; see
+        :meth:`health`)."""
         devices = devices if devices is not None else jax.devices()[:tp]
         mesh = make_mesh({"tp": tp}, devices)
         ff = FFModel(FFConfig(), mesh=mesh)
@@ -123,13 +128,26 @@ class LLM:
             self.rm = SpecInferManager(
                 self.im, ssm.im, gen, width=spec_width, depth=spec_depth,
                 telemetry=telemetry, resilience=resilience,
-                fault_injector=fault_injector,
+                fault_injector=fault_injector, plan_health=plan_health,
             )
         else:
             self.rm = RequestManager(self.im, gen, telemetry=telemetry,
                                      resilience=resilience,
-                                     fault_injector=fault_injector)
+                                     fault_injector=fault_injector,
+                                     plan_health=plan_health)
         return self
+
+    def health(self):
+        """Run (and return) one plan-health check NOW: live TTFT/TPOT vs
+        the executing plan's predictions and SLO targets, plus workload
+        drift vs the planned-for profile.  None when no monitor was
+        attached at :meth:`compile` time.  Recommendation-only — a
+        returned ``replan_recommended`` report names a candidate plan but
+        nothing migrates (that rides the r9 preemption path in a later
+        PR)."""
+        if self.rm is None or self.rm.plan_health is None:
+            return None
+        return self.rm.plan_health.check()
 
     # ------------------------------------------------------------------
     def generate(

@@ -118,7 +118,7 @@ class RequestManager:
 
     def __init__(self, im, gen_config: Optional[GenerationConfig] = None,
                  telemetry=None, resilience: Optional[ResilienceConfig] = None,
-                 fault_injector=None, clock=None):
+                 fault_injector=None, clock=None, plan_health=None):
         import time as _time
 
         self.im = im
@@ -167,6 +167,15 @@ class RequestManager:
         self.clock = clock or _time.perf_counter
         self._sleep = _time.sleep
         self._kv_bytes_tok: Optional[float] = None
+        # plan-health monitoring (obs/plan_health.py): an attached
+        # PlanHealthMonitor is polled every ``health_check_every`` serve
+        # ticks (and once when a serve loop drains) — host-side arithmetic
+        # over the telemetry registry only, so attaching one can never
+        # change serve outputs (tests/test_plan_health.py bit-identity).
+        # Recommendation-only: the monitor emits ``replan_recommended``;
+        # nothing here acts on it (live migration rides a later PR).
+        self.plan_health = plan_health
+        self._health_ticks = 0
 
     def _sample_arg(self):
         """Legacy per-call sampling arg ``(key, temperature, top_p)``, or
@@ -875,6 +884,10 @@ class RequestManager:
     # pending cancel: bounds how far past a deadline a stretch can run
     # (lifecycle reaping is step-boundary-granular)
     lifecycle_quantum = 8
+    # serve ticks between plan-health polls when a monitor is attached
+    # (each poll is host-side percentile/PSI arithmetic — cheap, but not
+    # free enough for every tick of a hot decode loop)
+    health_check_every = 16
 
     # ------------------------------------------------------------------
     def _prefill_stretch_possible(self) -> bool:
@@ -1066,6 +1079,16 @@ class RequestManager:
                 self.process_result(result, sample_points)
             self.steps += 1
 
+    def _maybe_check_health(self, force: bool = False) -> None:
+        """Poll the attached plan-health monitor every
+        ``health_check_every`` ticks (``force`` = loop drained: one final
+        check so short runs still get evaluated exactly once)."""
+        if self.plan_health is None:
+            return
+        self._health_ticks += 1
+        if force or self._health_ticks % self.health_check_every == 0:
+            self.plan_health.check()
+
     def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8):
         """Arrival-driven serving: requests join the running admit/retire
         loop at their offered times (open-loop load, the serving_under_load
@@ -1187,6 +1210,7 @@ class RequestManager:
                 self.scan_chunk = quantum if pending else saved_chunk
                 starters = prefill_starters()
                 self._serve_tick()
+                self._maybe_check_health()
                 for rid in starters:
                     if self.requests[rid].prefill_offset > 0:
                         records[rid]["prefill_start_s"] = now
@@ -1194,6 +1218,7 @@ class RequestManager:
                             tel.request_prefill_started(
                                 self.requests[rid].trace_id)
                 stamp(clock() - t0)
+            self._maybe_check_health(force=True)
         finally:
             self.scan_chunk = saved_chunk
             self._swap_clock(saved_clock)
@@ -1230,6 +1255,8 @@ class RequestManager:
             if not self.has_work():
                 break
             self._serve_tick()
+            self._maybe_check_health()
+        self._maybe_check_health(force=True)
         return {rid: r.generated for rid, r in self.requests.items()}
 
     _serve = serve_incr_decoding  # overridden by SpecInferManager

@@ -94,10 +94,12 @@ class SpecInferManager(RequestManager):
         resilience=None,
         fault_injector=None,
         clock=None,
+        plan_health=None,
     ):
         super().__init__(llm, gen_config, telemetry=telemetry,
                          resilience=resilience,
-                         fault_injector=fault_injector, clock=clock)
+                         fault_injector=fault_injector, clock=clock,
+                         plan_health=plan_health)
         if self.res.preemption:
             # recompute-based preemption needs the incremental prefill
             # paths (prefill_src); the spec macro-step's three-phase cache
@@ -400,6 +402,13 @@ class SpecInferManager(RequestManager):
                     new_tokens.append(node.token)
             new_tokens.append(bonus)
             req.llm_committed += len(accepted_nodes)
+            # acceptance telemetry: draft tokens that survived the walk
+            # this round (the root is committed context, not a draft) —
+            # feeds the workload profile's spec_acceptance histogram so
+            # acceptance-rate drift is visible to the planner
+            if self.telemetry.enabled and len(req.tree) > 1:
+                self.telemetry.spec_acceptance(
+                    len(accepted_nodes) - 1, len(req.tree) - 1)
             # SSM needs the same accepted tokens in its committed cache; the
             # root (generated[-1] pre-walk) is part of them
             base_pos = req.ssm_committed

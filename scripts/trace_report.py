@@ -1,16 +1,32 @@
-"""Summarize a serving-telemetry JSONL export.
+"""Summarize (or validate) a serving-telemetry JSONL export.
 
 Usage::
 
     python scripts/trace_report.py artifacts/telemetry/serve.jsonl
+    python scripts/trace_report.py --check artifacts/telemetry/serve.jsonl
 
-Prints one JSON document: request counts, p50/p95 TTFT / TPOT /
-queue-wait (derived from the request-lifecycle events), the terminal
-outcome mix and resilience counters (rejected / cancelled / timeout /
-preempted / failed, dispatch retries + faults, recompute tokens),
-per-track span totals (pipeline stage interleave), the pp bubble
-fraction, and the per-plan predicted-vs-measured error table from the
-calibration ledger.
+Default mode prints one JSON document: request counts, p50/p95 TTFT /
+TPOT / queue-wait (derived from the request-lifecycle events), the
+terminal outcome mix and resilience counters (rejected / cancelled /
+timeout / preempted / failed, dispatch retries + faults, recompute
+tokens), per-track span totals (pipeline stage interleave), the pp bubble
+fraction, the per-plan predicted-vs-measured error table from the
+calibration ledger — and the plan feedback loop's view: the live
+workload-drift score + per-dimension window means, ``drift_detected`` /
+``replan_recommended`` events, and the CalibrationStore scales that were
+auto-applied to the search's predictions.
+
+A trace whose ring buffer dropped events is TRUNCATED — the summary is
+computed from what survived — so ``dropped > 0`` prints an explicit
+warning to stderr (satellite of ISSUE 6: a truncated trace must not
+masquerade as a complete one).
+
+``--check`` validates the JSONL against the expected event schema
+(:func:`flexflow_tpu.obs.report.validate_jsonl` — line kinds, per-phase
+trace-event fields, and the typed request/dispatch/plan vocabulary from
+``telemetry.EVENT_SCHEMA``) and exits nonzero on unknown/missing fields,
+so the bench emitters and this report's parser can never drift apart
+silently (a tier-1 test runs it on ``bench.py --dry-run`` output).
 
 The reduction itself lives in :mod:`flexflow_tpu.obs.report`
 (``summarize_jsonl``) so ``bench.py --dry-run``'s observability section and
@@ -33,11 +49,29 @@ def main(argv=None) -> int:
     ap.add_argument("jsonl", help="path to a Telemetry.export *.jsonl")
     ap.add_argument("--indent", type=int, default=None,
                     help="pretty-print with this JSON indent")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the JSONL against the expected event "
+                         "schema instead of summarizing; exit nonzero on "
+                         "unknown/missing fields")
     args = ap.parse_args(argv)
+
+    if args.check:
+        from flexflow_tpu.obs.report import validate_jsonl
+
+        errors = validate_jsonl(args.jsonl)
+        print(json.dumps({"ok": not errors, "path": args.jsonl,
+                          "errors": errors}, indent=args.indent))
+        return 1 if errors else 0
 
     from flexflow_tpu.obs.report import summarize_jsonl
 
-    print(json.dumps(summarize_jsonl(args.jsonl), indent=args.indent))
+    summary = summarize_jsonl(args.jsonl)
+    if summary.get("dropped"):
+        print(f"WARNING: trace ring dropped {summary['dropped']} of "
+              f"{summary['events']} events — this summary is computed "
+              "from a TRUNCATED trace (raise Telemetry(capacity=...) to "
+              "keep the full run)", file=sys.stderr)
+    print(json.dumps(summary, indent=args.indent))
     return 0
 
 

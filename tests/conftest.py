@@ -10,6 +10,10 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets JAX_PLATFORMS=axon (TPU)
 os.environ["FLEXFLOW_TPU_RUN_LOG"] = ""  # no run-log pollution from tests
+# hermetic searches: a CalibrationStore an operator persisted to the repo
+# artifact must never silently steer test searches ("" disables the
+# calibration="auto" consult; tests pass stores/paths explicitly)
+os.environ["FLEXFLOW_TPU_CALIBRATION_STORE"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()

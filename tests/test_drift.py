@@ -1,0 +1,191 @@
+"""Workload characterization + drift detection (obs/drift.py).
+
+Hermetic host-side coverage: histogram windows, PSI properties, the
+detector's threshold/edge-trigger semantics and its telemetry emission,
+and the Telemetry handle maintaining the profile from the SAME lifecycle
+calls the serving stack makes.
+"""
+
+import numpy as np
+
+from flexflow_tpu.obs import (
+    DriftDetector,
+    Telemetry,
+    WorkloadProfile,
+    drift_score,
+    psi,
+)
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# profile windows + features
+# ---------------------------------------------------------------------------
+def test_profile_histograms_and_features():
+    wp = WorkloadProfile(window=64)
+    for i in range(32):
+        wp.observe_enqueue(60 + (i % 8), ts=i * 0.05)  # 20 req/s
+        wp.observe_finish(16)
+        wp.observe_occupancy(0.5)
+    snap = wp.snapshot()
+    d = snap["dims"]["prompt_len"]
+    assert d["n"] == 32
+    assert sum(d["counts"]) == 32
+    # 60..67 all land in the (32, 64] and (64, 128] buckets
+    assert d["counts"][d["edges"].index(64)] > 0
+    f = wp.features()
+    assert 59 < f["mean_prompt_len"] < 69
+    assert f["mean_output_len"] == 16
+    assert abs(f["arrival_rate_per_s"] - 20.0) < 1e-6
+    assert f["mean_occupancy"] == 0.5
+    assert f["n_requests"] == 32
+
+
+def test_profile_window_bounds_memory_and_tracks_recent():
+    wp = WorkloadProfile(window=16)
+    for _ in range(100):
+        wp.observe_enqueue(10)
+    for _ in range(16):
+        wp.observe_enqueue(1000)
+    snap = wp.snapshot()["dims"]["prompt_len"]
+    assert snap["n"] == 16          # window view
+    assert snap["count"] == 116     # lifetime count survives
+    assert snap["mean"] == 1000     # old traffic fully displaced
+
+
+def test_out_of_order_arrival_timestamps_do_not_crash():
+    wp = WorkloadProfile()
+    wp.observe_enqueue(8, ts=5.0)
+    wp.observe_enqueue(8, ts=3.0)   # clock swap / rebase: skip, re-anchor
+    wp.observe_enqueue(8, ts=4.0)
+    assert wp.snapshot()["dims"]["interarrival_s"]["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# PSI
+# ---------------------------------------------------------------------------
+def test_psi_zero_for_identical_and_large_for_disjoint():
+    a = [10, 20, 30, 5]
+    assert psi(a, a) == 0.0
+    assert psi(a, [20, 40, 60, 10]) < 1e-12  # scale-invariant
+    disjoint = psi([50, 0, 0, 0], [0, 0, 0, 50])
+    assert disjoint > 1.0
+    # symmetric
+    assert abs(psi([5, 10, 2], [2, 9, 6]) - psi([2, 9, 6], [5, 10, 2])) \
+        < 1e-12
+
+
+def test_drift_score_skips_thin_dimensions():
+    ref = WorkloadProfile()
+    live = WorkloadProfile()
+    for _ in range(20):
+        ref.observe_enqueue(16)
+        live.observe_enqueue(512)
+    ref.observe_finish(8)   # 1 sample: below min_samples
+    live.observe_finish(9)
+    rep = drift_score(ref.snapshot(), live.snapshot(), min_samples=16)
+    assert "prompt_len" in rep["per_dim"]
+    assert rep["worst_dim"] == "prompt_len"
+    assert "output_len" in rep["skipped"]
+    assert rep["score"] == rep["per_dim"]["prompt_len"] > 0.25
+
+
+# ---------------------------------------------------------------------------
+# detector: threshold, telemetry, edge trigger
+# ---------------------------------------------------------------------------
+def test_detector_emits_gauge_and_edge_triggered_instant():
+    ref = WorkloadProfile()
+    for _ in range(20):
+        ref.observe_enqueue(16)
+    det = DriftDetector(ref, threshold=0.25, min_samples=16)
+    tel = Telemetry(clock=ManualClock())
+
+    same = WorkloadProfile()
+    for _ in range(20):
+        same.observe_enqueue(16)
+    rep = det.check(same, telemetry=tel)
+    assert not rep["drifted"] and rep["score"] == 0.0
+    assert tel.metrics.snapshot()["workload_drift_score"] == 0.0
+
+    shifted = WorkloadProfile()
+    for _ in range(20):
+        shifted.observe_enqueue(2048)
+    rep = det.check(shifted, telemetry=tel)
+    assert rep["drifted"] and rep["score"] >= 0.25
+    assert rep["worst_dim"] == "prompt_len"
+    snap = tel.metrics.snapshot()
+    assert snap["workload_drift_score"] == rep["score"]
+    assert snap["workload_psi_prompt_len"] == rep["per_dim"]["prompt_len"]
+
+    # still drifted: NO second instant (edge-triggered, not level)
+    det.check(shifted, telemetry=tel)
+    events = [e for e in tel.trace.trace_events()
+              if e.get("name") == "drift_detected"]
+    assert len(events) == 1
+    assert events[0]["args"]["score"] == rep["score"]
+    assert events[0]["cat"] == "plan"
+
+    # recovery re-arms the trigger
+    det.check(same, telemetry=tel)
+    det.check(shifted, telemetry=tel)
+    events = [e for e in tel.trace.trace_events()
+              if e.get("name") == "drift_detected"]
+    assert len(events) == 2
+
+
+# ---------------------------------------------------------------------------
+# telemetry handle maintains the profile from the lifecycle schema
+# ---------------------------------------------------------------------------
+def test_telemetry_feeds_workload_profile():
+    clk = ManualClock()
+    tel = Telemetry(clock=clk)
+    for i in range(10):
+        clk.advance(0.05)
+        tel.request_enqueued(f"r{i:05d}", prompt_len=40 + i)
+        tel.request_finished(f"r{i:05d}", n_tokens=6)
+    tel.batch_composition(4, 0, active_requests=6, max_requests=8,
+                          kv_tokens=100, kv_capacity=1024)
+    tel.spec_acceptance(3, 4)
+    f = tel.workload.features()
+    assert 40 <= f["mean_prompt_len"] <= 49
+    assert f["mean_output_len"] == 6
+    assert abs(f["arrival_rate_per_s"] - 20.0) < 1.0
+    assert f["mean_occupancy"] == 0.75
+    assert f["mean_spec_acceptance"] == 0.75
+    snap = tel.metrics.snapshot()
+    assert snap["spec_tokens_drafted"] == 4
+    assert snap["spec_tokens_accepted"] == 3
+    # the handle's snapshot carries the feature view
+    assert tel.snapshot()["workload"]["mean_output_len"] == 6
+
+
+def test_workload_rides_the_jsonl_export(tmp_path):
+    import json
+
+    tel = Telemetry(clock=ManualClock())
+    for _ in range(4):
+        tel.request_enqueued("rX", prompt_len=77)
+    paths = tel.export(str(tmp_path))
+    kinds = {}
+    with open(paths["jsonl"]) as f:
+        for line in f:
+            doc = json.loads(line)
+            kinds[doc["kind"]] = doc
+    assert "workload" in kinds
+    assert kinds["workload"]["snapshot"]["dims"]["prompt_len"]["n"] == 4
+    # Perfetto export carries the ring accounting metadata (satellite:
+    # truncated traces cannot masquerade as complete)
+    with open(paths["trace_json"]) as f:
+        doc = json.load(f)
+    assert doc["metadata"]["trace_events_emitted"] == tel.trace.emitted
+    assert doc["metadata"]["trace_events_dropped"] == 0

@@ -13,22 +13,36 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 
-def _run(args, **kw):
+def _run_raw(args, **kw):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run([sys.executable] + args, capture_output=True,
+    return subprocess.run([sys.executable] + args, capture_output=True,
                           text=True, timeout=300, cwd=REPO, env=env, **kw)
+
+
+def _run(args, **kw):
+    proc = _run_raw(args, **kw)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     return proc.stdout.strip().splitlines()[-1]
 
 
-def test_dry_run_observability_roundtrips_through_trace_report(tmp_path):
-    out = str(tmp_path / "telemetry")
+@pytest.fixture(scope="module")
+def dryrun(tmp_path_factory):
+    """ONE bench --dry-run subprocess shared by every test here (the
+    feedback-loop sections build graphs — not free to repeat per test)."""
+    out = str(tmp_path_factory.mktemp("telemetry"))
     doc = json.loads(_run([os.path.join(REPO, "bench.py"),
                            "--dry-run", "--out", out]))
+    return out, doc
+
+
+def test_dry_run_observability_roundtrips_through_trace_report(dryrun):
+    out, doc = dryrun
     obs = doc["observability"]
     jsonl = obs["paths"]["jsonl"]
     assert os.path.exists(jsonl)
@@ -67,6 +81,128 @@ def test_dry_run_observability_roundtrips_through_trace_report(tmp_path):
     reported = json.loads(_run(
         [os.path.join(REPO, "scripts", "trace_report.py"), jsonl]))
     assert reported == s, "trace_report.py diverged from the in-process summary"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: the observe->calibrate->re-plan loop, hermetically on the
+# virtual clock, round-tripped through trace_report
+# ---------------------------------------------------------------------------
+def test_dry_run_calibration_loop_reduces_error(dryrun):
+    _, doc = dryrun
+    cl = doc["observability"]["feedback_loop"]["calibration_loop"]
+    # deliberately mis-scaled constants produced a real ledger...
+    assert cl["error_frac_before"] > 0.3
+    comps = cl["components"]
+    assert comps["tpot_ms"]["n"] >= 2 and not comps["tpot_ms"]["low_confidence"]
+    # ...and the auto-applied store scales cut the replayed error
+    assert cl["improved"]
+    assert cl["error_frac_after"] < cl["error_frac_before"] * 0.5
+    assert cl["applied_scales"]["tpot_ms"] > 1.2
+    assert os.path.exists(cl["store_path"])
+
+
+def test_dry_run_workload_drift_recommends_replan(dryrun):
+    _, doc = dryrun
+    fb = doc["observability"]["feedback_loop"]
+    wd = fb["workload_drift"]
+    # clean before the shift, drifted after, and the candidate differs
+    assert wd["healthy_before"] and wd["drift_score_before"] < 0.25
+    assert wd["drifted"] and wd["drift_score_after"] >= 0.25
+    assert "workload_drift" in wd["reasons"]
+    assert wd["replan_recommended"]
+    assert wd["candidate"]["plan_key"] != wd["incumbent"]
+    # the shifted mix is visible in the live features
+    assert wd["live_features"]["mean_prompt_len"] > 256
+
+    # full round trip: the loop JSONL reproduces drift + replan + scales
+    s = fb["summary"]
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         fb["paths"]["jsonl"]]))
+    assert reported == s
+    assert reported["workload_drift_score"] >= 0.25
+    assert len(reported["drift_detected"]) == 1
+    [replan] = reported["replan_recommended"]
+    assert replan["incumbent"] == wd["incumbent"]
+    assert replan["candidate"] == wd["candidate"]["plan_key"]
+    assert reported["applied_scales"] == fb["calibration_loop"][
+        "applied_scales"]
+    assert reported["workload"]["prompt_len"]["mean"] > 256
+
+
+def test_check_mode_validates_dry_run_schema(dryrun):
+    out, doc = dryrun
+    script = os.path.join(REPO, "scripts", "trace_report.py")
+    for jsonl in (doc["observability"]["paths"]["jsonl"],
+                  doc["observability"]["feedback_loop"]["paths"]["jsonl"]):
+        res = json.loads(_run([script, "--check", jsonl]))
+        assert res["ok"] and res["errors"] == []
+
+
+def test_check_mode_rejects_schema_violations(tmp_path):
+    script = os.path.join(REPO, "scripts", "trace_report.py")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join([
+        json.dumps({"kind": "telemetry_meta", "version": 1, "ts_unit": "us",
+                    "events": 2, "dropped": 0}),
+        # unknown lifecycle event name
+        json.dumps({"kind": "event", "name": "request_vanish", "cat":
+                    "request", "ph": "i", "pid": 1, "tid": 1, "ts": 1.0,
+                    "s": "t", "args": {"trace_id": "r0"}}),
+        # missing required arg (trace_id)
+        json.dumps({"kind": "event", "name": "request_finish", "cat":
+                    "request", "ph": "i", "pid": 1, "tid": 1, "ts": 2.0,
+                    "s": "t", "args": {"n_tokens": 3}}),
+        # unknown line kind
+        json.dumps({"kind": "mystery"}),
+    ]) + "\n")
+    proc = _run_raw([script, "--check", str(bad)])
+    assert proc.returncode == 1
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert not res["ok"]
+    joined = " ".join(res["errors"])
+    assert "request_vanish" in joined
+    assert "trace_id" in joined
+    assert "mystery" in joined
+
+    # a meta-less file is flagged too (dropped counts are load-bearing)
+    nometa = tmp_path / "nometa.jsonl"
+    nometa.write_text(json.dumps({"kind": "metrics", "snapshot": {}}) + "\n")
+    proc = _run_raw([script, "--check", str(nometa)])
+    assert proc.returncode == 1
+    assert "telemetry_meta" in proc.stdout
+
+
+def test_truncated_trace_warns_loudly(tmp_path):
+    """Satellite: a ring that dropped events must not masquerade as a
+    complete trace — meta carries emitted/dropped and the CLI warns."""
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    tel = Telemetry(capacity=8, clock=Clock())
+    for i in range(30):
+        tel.request_enqueued(f"r{i:05d}", prompt_len=4)
+    paths = tel.export(str(tmp_path))
+    s = summarize_jsonl(paths["jsonl"])
+    assert s["events"] == 30 and s["dropped"] == 22
+    # Perfetto metadata carries the same accounting
+    with open(paths["trace_json"]) as f:
+        meta = json.load(f)["metadata"]
+    assert meta["trace_events_emitted"] == 30
+    assert meta["trace_events_dropped"] == 22
+    # the CLI prints an explicit stderr warning (stdout stays pure JSON)
+    proc = _run_raw([os.path.join(REPO, "scripts", "trace_report.py"),
+                     paths["jsonl"]])
+    assert proc.returncode == 0
+    assert "TRUNCATED" in proc.stderr and "22" in proc.stderr
+    assert json.loads(proc.stdout.strip().splitlines()[-1]) == s
 
 
 def test_trace_report_on_exported_telemetry(tmp_path):
