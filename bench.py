@@ -32,7 +32,15 @@ import numpy as np
 def _enable_compile_cache():
     """Persistent XLA compilation cache: repeated bench runs re-compile the
     same serve/scan programs (~30-60s each through the tunnel AOT helper);
-    caching them makes iteration and re-runs cheap."""
+    caching them makes iteration and re-runs cheap.
+
+    Called from :func:`main` — NOT at import — because tests import bench
+    for its dry-run sections, and enabling the cache inside a pytest
+    process re-arms the jaxlib crash tests/conftest.py opts out of:
+    collective programs (GPipe ppermute-in-scan, ring attention)
+    DESERIALIZED from the cache segfault this jaxlib's in-process CPU
+    collectives, killing the whole suite once the cache holds those
+    entries from a prior run."""
     import jax
 
     try:
@@ -41,9 +49,6 @@ def _enable_compile_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     except Exception:
         pass  # old jax without the knobs: benching still works
-
-
-_enable_compile_cache()
 
 
 def release_im(im):
@@ -1112,6 +1117,17 @@ def searched_vs_dp_fields():
         return {"searched_vs_dp_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+class _Tick:
+    """Deterministic virtual clock for the dry-run sections: 1ms per
+    reading (shared by observability_dryrun and memory_ledger_dryrun)."""
+
+    t = 0.0
+
+    def __call__(self):
+        self.t += 1e-3
+        return self.t
+
+
 def observability_dryrun(out_dir=None):
     """Hermetic ``--dry-run`` observability section: drive the telemetry
     pipeline end to end (trace ring, metrics registry, calibration ledger,
@@ -1130,13 +1146,6 @@ def observability_dryrun(out_dir=None):
     from flexflow_tpu.obs import Telemetry
     from flexflow_tpu.obs.report import summarize_jsonl
     from flexflow_tpu.obs.telemetry import RESILIENCE_COUNTERS
-
-    class _Tick:  # deterministic virtual clock: 1ms per reading
-        t = 0.0
-
-        def __call__(self):
-            self.t += 1e-3
-            return self.t
 
     tel = Telemetry(clock=_Tick())
 
@@ -1464,11 +1473,95 @@ def feedback_loop_dryrun(out_dir=None):
     }
 
 
+def memory_ledger_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` memory-observability section: a REAL tiny
+    InferenceManager's :class:`~flexflow_tpu.serve.kv_allocator.KVAllocator`
+    driven fill -> preempt -> release on a virtual clock (no jitted step
+    ever runs — allocation and attribution are host-side bookkeeping), so
+    the exported ledger reconciles all three views with no device:
+
+    * predicted — ``plan_memory_parts`` over the compiled plan, per
+      component (``publish_memory``'s search-side arithmetic);
+    * allocated — the real parameter + cache buffer bytes;
+    * live — the fill/preempt/release occupancy watermarks.
+
+    ``device_fields`` are the stamp-ready slots the r6–r9 backlog's
+    ``hbm_frac`` close-out fills from a real chip (live watermark over
+    REAL per-device HBM, vs today's host-array accounting).
+
+    The JSONL round-trip (``summarize_jsonl`` == ``scripts/trace_report.py``
+    output, ``--check`` clean) is pinned by tests/test_trace_report.py.
+    """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl
+
+    tel = Telemetry(clock=_Tick())
+    # max_seq 128 = the cache lane-pad quantum, so the predicted KV bytes
+    # (unpadded specs) and the allocated buffers (seq padded to 128) agree
+    # exactly and the reconciliation tolerance tests the MODEL, not padding
+    im = build_im(False, layers=2, hidden=64, heads=4, kv=4, inter=128,
+                  vocab=128, max_requests=4, max_seq=128)
+    im.publish_memory(tel)  # predicted + allocated sides of the ledger
+    kv = im.kv
+    per_tok = kv.bytes_per_token()
+
+    # fill: three requests bind slots and their cache depths grow
+    for rid in (0, 1, 2):
+        tid = f"m{rid:05d}"
+        t0 = tel.request_enqueued(tid, prompt_len=8 + 4 * rid)
+        tel.request_admitted(tid, queue_wait_s=tel.now() - t0)
+        kv.bind(rid)
+    depth = {0: 8, 1: 12, 2: 16}
+    for step in range(4):
+        kv.observe({r: d + 2 * step for r, d in depth.items()}, tel)
+    fill_snap = kv.snapshot()
+
+    # preempt: rid 2 is evicted (slot pressure); its attribution releases
+    # at the peak depth it reached, and occupancy visibly drops
+    preempt_bytes = kv.release(2)
+    tel.request_preempted("m00002", recompute_tokens=depth[2] + 6)
+    kv.observe({r: depth[r] + 8 for r in (0, 1)}, tel)
+
+    # release: the survivors finish; every binding returns its attribution
+    for rid in (0, 1):
+        b = kv.release(rid)
+        tel.request_finished(f"m{rid:05d}", n_tokens=8,
+                             tpot_s=1e-3, kv_bytes=b)
+    leak_free = not kv.attributed_rids()
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    paths = tel.export(out_dir, prefix="dryrun_memory")
+    ledger = tel.memory.report()
+    return {
+        "paths": paths,
+        "summary": summarize_jsonl(paths["jsonl"])["memory"],
+        "ledger": ledger,
+        "kv_bytes_per_token": per_tok,
+        "fill_occupancy_frac": round(fill_snap["occupancy_frac"], 4),
+        "preempt_released_bytes": preempt_bytes,
+        "leak_free": leak_free,
+        "device_fields": {
+            # stamped by a real device run: live HWM over REAL per-chip
+            # HBM (the r6-r9 hbm_frac close-out basis), not host arrays
+            "hbm_frac": None,
+            "hbm_capacity_gb": None,
+            "kv_hwm_gb": None,
+        },
+        "note": "real tiny InferenceManager (CPU host arrays, no jitted "
+                "step): KVAllocator fill->preempt->release on a virtual "
+                "clock; predicted (plan_memory_parts) vs allocated (real "
+                "buffers) reconciles per component in ledger.plans",
+    }
+
+
 def main(argv=None):
     import argparse
     import os
     import sys
 
+    _enable_compile_cache()  # program-mode only; see the docstring
     ap = argparse.ArgumentParser(description="flexflow_tpu bench")
     ap.add_argument("--dry-run", action="store_true",
                     help="hermetic observability-only run: exercise the "
@@ -1480,6 +1573,7 @@ def main(argv=None):
     if args.dry_run:
         doc = observability_dryrun(args.out)
         doc["observability"]["feedback_loop"] = feedback_loop_dryrun(args.out)
+        doc["observability"]["memory_ledger"] = memory_ledger_dryrun(args.out)
         print(json.dumps(doc))
         return
 

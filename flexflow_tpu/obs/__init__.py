@@ -20,6 +20,11 @@ from .drift import (
     drift_score,
     psi,
 )
+from .memory import (
+    KV_OCCUPANCY_HIST,
+    MEMORY_GAUGES,
+    MemoryLedger,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -29,6 +34,7 @@ from .metrics import (
 )
 from .plan_health import PlanHealthConfig, PlanHealthMonitor
 from .report import (
+    memory_section,
     summarize_events,
     summarize_jsonl,
     under_load_summary,
@@ -63,6 +69,10 @@ __all__ = [
     "psi",
     "PlanHealthConfig",
     "PlanHealthMonitor",
+    "MemoryLedger",
+    "MEMORY_GAUGES",
+    "KV_OCCUPANCY_HIST",
+    "memory_section",
     "summarize_events",
     "summarize_jsonl",
     "under_load_summary",

@@ -5,7 +5,13 @@ ROADMAP "online re-planning"): the search predicted a TPOT/TTFT for the
 plan it picked, the operator has SLO targets, and the plan was priced for
 one workload profile — this monitor watches all three and, when any
 breaks, re-runs the serve search on the DRIFTED profile and emits a
-``replan_recommended`` instant carrying the candidate plan.
+``replan_recommended`` instant carrying the candidate plan.  With a
+:class:`~flexflow_tpu.serve.kv_allocator.KVAllocator` attached it also
+watches the BYTE side: projected KV growth from the live workload
+profile vs the allocator's real headroom, breaching as
+``memory_pressure`` (r12's memory-observability layer) — capacity is the
+binding constraint for serving, so running out of HBM is a plan-health
+failure exactly like missing an SLO.
 
 **Recommendation-only by design (this PR).**  The monitor never touches
 the executing engine: live migration needs the r9 preemption-and-recompute
@@ -40,6 +46,15 @@ class PlanHealthConfig:
       the classic "population has shifted" line).
     * ``min_requests``: finished requests before latency checks engage —
       percentile comparisons over a handful of requests are noise.
+    * ``memory_pressure_frac``: the OOM-risk line — breach when the
+      PROJECTED live KV (current occupied positions + every live request
+      growing by the workload profile's mean output length) exceeds this
+      fraction of the :class:`~flexflow_tpu.serve.kv_allocator.
+      KVAllocator`'s byte capacity.  The projection deliberately errs
+      high (each live request is priced at the FULL mean output, not the
+      remainder) — for OOM risk, a false alarm costs a re-search, a miss
+      costs the deployment.  1.0 = breach only when projected past
+      capacity; lower it to leave admission headroom.
     """
 
     slo_ttft_p95_s: Optional[float] = None
@@ -48,6 +63,7 @@ class PlanHealthConfig:
     drift_threshold: float = 0.25
     drift_min_samples: int = 16
     min_requests: int = 8
+    memory_pressure_frac: float = 1.0
 
 
 class PlanHealthMonitor:
@@ -62,6 +78,14 @@ class PlanHealthMonitor:
     profile, returning a plan dict — injected so hermetic tests (and
     deployments with custom search wiring) control it; None degrades to
     report-only health checks.
+    ``kv_allocator``: the deployment's
+    :class:`~flexflow_tpu.serve.kv_allocator.KVAllocator` — or a LIST of
+    allocators for multi-deployment serving (the spec manager wires
+    [target, draft] so projection and capacity cover both caches) —
+    enables the OOM-risk check (projected KV growth vs real headroom).
+    The RequestManager wires its manager's allocator in automatically
+    when the monitor is attached without one; None skips the memory
+    check.
 
     :meth:`check` returns the health report and, when any check fails AND
     the re-search returns a plan whose key differs from the incumbent,
@@ -72,7 +96,8 @@ class PlanHealthMonitor:
 
     def __init__(self, telemetry, plan: Dict, reference=None,
                  config: Optional[PlanHealthConfig] = None,
-                 search_fn: Optional[Callable[[], Dict]] = None):
+                 search_fn: Optional[Callable[[], Dict]] = None,
+                 kv_allocator=None):
         # None degrades to the no-op handle: checks still run (drift
         # against an empty window, latencies unavailable), nothing emits
         self.telemetry = telemetry_or_null(telemetry)
@@ -85,9 +110,11 @@ class PlanHealthMonitor:
             threshold=self.config.drift_threshold,
             min_samples=self.config.drift_min_samples)
         self.search_fn = search_fn
+        self.kv_allocator = kv_allocator
         self.checks = 0
         self.recommendation: Optional[Dict] = None
         self._last_candidate_key: Optional[str] = None
+        self._mem_pressure_active = False
 
     # ------------------------------------------------------------------
     def _hist(self, name: str) -> Dict:
@@ -144,11 +171,69 @@ class PlanHealthMonitor:
         if drift["drifted"]:
             reasons.append("workload_drift")
 
+        # 4. OOM risk (the byte-side check): project the live KV forward
+        # by the workload profile's mean output length per live request
+        # and compare against the allocator's REAL byte capacity — the
+        # one arithmetic admission and preemption already share.  Errs
+        # high by design (full mean output per request, not the
+        # remainder); a breach rides the same replan machinery as the
+        # time-side checks, and the edge-triggered ``memory_pressure``
+        # instant carries the projection so the report can show how close
+        # the deployment came.
+        kvs = self.kv_allocator
+        kvs = (list(kvs) if isinstance(kvs, (list, tuple))
+               else [kvs] if kvs is not None else [])
+        per_toks = [kv.bytes_per_token() for kv in kvs]
+        if kvs and all(per_toks):
+            # one buffer walk per allocator per check; each deployment's
+            # cache prices at its OWN bytes/token (target and draft
+            # differ), composed by summing bytes
+            cap_b = sum(kv.capacity_tokens * p
+                        for kv, p in zip(kvs, per_toks))
+            live_tok = sum(kv.live_tokens() for kv in kvs)
+            live_b = sum(kv.live_tokens() * p
+                         for kv, p in zip(kvs, per_toks))
+            mean_out = (tel.workload.features().get("mean_output_len", 0.0)
+                        if tel.enabled else 0.0)
+            # every live request grows EVERY cache it holds by the
+            # expected remaining output
+            n_live = max((kv.live_requests() for kv in kvs), default=0)
+            projected = live_b + sum(n_live * mean_out * p
+                                     for p in per_toks)
+            proj_frac = projected / cap_b if cap_b else 0.0
+            report["memory"] = {
+                "live_tokens": live_tok,
+                "live_bytes": round(live_b, 1),
+                "projected_bytes": round(projected, 1),
+                "capacity_bytes": round(cap_b, 1),
+                "projected_frac": round(proj_frac, 4),
+            }
+            if tel.enabled:
+                tel.metrics.gauge("kv_projected_frac").set(proj_frac)
+            if cap_b and proj_frac > cfg.memory_pressure_frac:
+                reasons.append("memory_pressure")
+                if tel.enabled and not self._mem_pressure_active:
+                    tel.instant(
+                        "memory_pressure", cat="plan", track="plan_health",
+                        projected_bytes=round(projected, 1),
+                        capacity_bytes=round(cap_b, 1),
+                        live_tokens=live_tok,
+                        headroom_bytes=round(cap_b - live_b, 1))
+                    tel.metrics.counter("memory_pressure_events").inc()
+                self._mem_pressure_active = True
+            else:
+                self._mem_pressure_active = False
+        else:
+            # a skipped memory check (caches freed/unallocated) must not
+            # carry a stale edge-trigger into the next allocated epoch —
+            # a fresh excursion there is a NEW event
+            self._mem_pressure_active = False
+
         report["healthy"] = not reasons
         if tel.enabled:
             tel.metrics.gauge("plan_health_ok").set(0.0 if reasons else 1.0)
 
-        # 4. unhealthy -> re-search on the live profile (recommendation
+        # 5. unhealthy -> re-search on the live profile (recommendation
         # only; the candidate must actually differ to be worth emitting)
         if reasons and self.search_fn is not None:
             try:

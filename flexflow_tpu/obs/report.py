@@ -38,6 +38,8 @@ _FAULT = "dispatch_fault"
 # observe->calibrate->re-plan loop events (obs/drift.py, obs/plan_health.py)
 _DRIFT = "drift_detected"
 _REPLAN = "replan_recommended"
+# memory observability (obs/memory.py): the OOM-risk breach instant
+_MEMPRESS = "memory_pressure"
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -61,6 +63,7 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     preemptions = retries = faults = 0
     drift_events: List[Dict] = []
     replans: List[Dict] = []
+    mem_pressure: List[Dict] = []
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -83,6 +86,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _REPLAN:
             replans.append(ev.get("args", {}))
+            continue
+        if name == _MEMPRESS:
+            mem_pressure.append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -144,6 +150,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         # plan feedback loop: drift excursions + replan recommendations
         "drift_detected": drift_events,
         "replan_recommended": replans,
+        # memory observability: OOM-risk breach instants (obs/plan_health.py)
+        "memory_pressure": mem_pressure,
     }
 
 
@@ -154,6 +162,7 @@ def summarize_jsonl(path: str) -> Dict:
     meta: Dict = {}
     metrics: Dict = {}
     calibration: Dict = {}
+    memory: Dict = {}
     workload: Dict = {}
     store: Dict = {}
     with open(path) as f:
@@ -171,6 +180,8 @@ def summarize_jsonl(path: str) -> Dict:
                 metrics = doc.get("snapshot", {})
             elif kind == "calibration":
                 calibration = doc.get("report", {})
+            elif kind == "memory":
+                memory = doc.get("report", {})
             elif kind == "workload":
                 workload = doc.get("snapshot", {})
             elif kind == "calibration_store":
@@ -206,7 +217,45 @@ def summarize_jsonl(path: str) -> Dict:
         pred_err[plan] = row
     summary["prediction_error"] = pred_err
     summary["calibration_components"] = calibration.get("components", {})
+    summary["memory"] = memory_section(memory, metrics)
+    summary["memory"]["pressure_events"] = summary.pop("memory_pressure")
     return summary
+
+
+def memory_section(memory: Dict, metrics: Dict) -> Dict:
+    """The byte-side summary: live watermarks + occupancy distribution +
+    the current gauge values + the per-component predicted-vs-allocated
+    error table (the memory ledger's analog of ``prediction_error``).
+
+    ``memory`` is a :meth:`~flexflow_tpu.obs.memory.MemoryLedger.report`
+    dict (the ``{"kind": "memory"}`` JSONL line); ``metrics`` a registry
+    snapshot — the gauge/histogram names come from ``MEMORY_GAUGES`` /
+    ``KV_OCCUPANCY_HIST`` so the emitter and this reduction share one
+    vocabulary.  Shared by ``bench.py --dry-run``'s ``memory_ledger``
+    section and the trace-report CLI (one accounting, two consumers).
+    """
+    from .memory import KV_OCCUPANCY_HIST, MEMORY_GAUGES
+
+    occ = metrics.get(KV_OCCUPANCY_HIST) or {}
+    section: Dict = {
+        "live": memory.get("live", {}),
+        "occupancy_p50": occ.get("p50"),
+        "occupancy_p95": occ.get("p95"),
+        "gauges": {g: metrics[g] for g in MEMORY_GAUGES if g in metrics},
+        "request_kv_bytes": metrics.get("request_kv_bytes"),
+    }
+    alloc_err: Dict[str, Dict] = {}
+    for plan, fields in memory.get("plans", {}).items():
+        alloc_err[plan] = {
+            f: {"predicted": e.get("predicted"),
+                "allocated": e.get("measured"),
+                "error_frac": e.get("error_frac")}
+            for f, e in fields.items()}
+    section["allocation_error"] = alloc_err
+    # the per-component suggested_scale table that feeds MachineModel
+    # memory-constant calibration (same geometry as the time components)
+    section["components"] = memory.get("components", {})
+    return section
 
 
 # JSONL line kinds Telemetry.export writes -> fields each must carry
@@ -215,6 +264,7 @@ _REQUIRED_BY_KIND = {
     "event": (),                      # per-phase rules below
     "metrics": ("snapshot",),
     "calibration": ("report",),
+    "memory": ("report",),
     "workload": ("snapshot",),
     "calibration_store": ("components", "applied_scales"),
 }

@@ -37,6 +37,7 @@ from typing import Callable, Dict, Optional
 
 from .calibration import CalibrationLedger
 from .drift import WorkloadProfile
+from .memory import KV_OCCUPANCY_HIST, MEMORY_GAUGE_KEYS, MemoryLedger
 from .metrics import MetricsRegistry
 from .trace import TraceRecorder
 
@@ -72,6 +73,10 @@ EVENT_SCHEMA = {
     # the observe->calibrate->re-plan loop (obs/drift.py, obs/plan_health.py)
     "drift_detected": ("plan", ("score",)),
     "replan_recommended": ("plan", ("incumbent", "candidate")),
+    # memory observability (obs/memory.py, serve/kv_allocator.py): the
+    # OOM-risk breach PlanHealthMonitor emits when projected KV growth
+    # from the live workload profile eats the allocator's headroom
+    "memory_pressure": ("plan", ("projected_bytes", "capacity_bytes")),
 }
 
 
@@ -90,6 +95,11 @@ class Telemetry:
         # of drift detection (obs/drift.py).  It reuses the trace events'
         # timestamps, so enabling it costs no extra clock reads.
         self.workload = WorkloadProfile(window=workload_window)
+        # the byte-side ledger (obs/memory.py): predicted-vs-allocated HBM
+        # per plan component + live watermarks — the analog of
+        # ``calibration`` for memory.  Fed by the managers' publish_memory
+        # and the KVAllocator's per-tick kv_usage observations.
+        self.memory = MemoryLedger()
         # optional persisted CalibrationStore: attach one to have export()
         # write its applied scales alongside the ledger report
         self.store = None
@@ -141,15 +151,20 @@ class Telemetry:
                                   ttft_s=ttft_s)
 
     def request_finished(self, trace_id: str, n_tokens: int,
-                         tpot_s: Optional[float] = None) -> float:
+                         tpot_s: Optional[float] = None,
+                         kv_bytes: Optional[float] = None) -> float:
+        """``kv_bytes``: the KVAllocator's per-request attribution (peak
+        cache bytes the request held) — the byte-side cost of serving it."""
         self.metrics.counter("requests_finished").inc()
         self.metrics.counter("tokens_generated").inc(n_tokens)
         if tpot_s is not None:
             self.metrics.histogram("tpot_s").observe(tpot_s)
+        if kv_bytes is not None:
+            self.metrics.histogram("request_kv_bytes").observe(kv_bytes)
         self.workload.observe_finish(n_tokens)
         return self.trace.instant("request_finish", "request", "requests",
                                   trace_id=trace_id, n_tokens=n_tokens,
-                                  tpot_s=tpot_s)
+                                  tpot_s=tpot_s, kv_bytes=kv_bytes)
 
     # ---- resilient serving (serve/resilience.py) ----------------------
     def request_rejected(self, trace_id: str, reason: str = "") -> float:
@@ -233,12 +248,38 @@ class Telemetry:
     def record_plan_measured(self, plan_key: str, **fields) -> None:
         self.calibration.measure(plan_key, **fields)
 
+    # ---- memory observability (obs/memory.py) -------------------------
+    def kv_usage(self, snap: Dict) -> None:
+        """One KVAllocator occupancy observation (see
+        :meth:`~flexflow_tpu.serve.kv_allocator.KVAllocator.observe` for
+        the snapshot fields): publishes the live-side gauge vocabulary
+        (``MEMORY_GAUGES``), the occupancy histogram/counter series, and
+        folds the watermark into the memory ledger."""
+        m = self.metrics
+        occ = snap.get("occupancy_frac", 0.0)
+        for gauge, key in MEMORY_GAUGE_KEYS.items():
+            m.gauge(gauge).set(snap.get(key, 0.0))
+        m.histogram(KV_OCCUPANCY_HIST).observe(occ)
+        self.trace.counter("kv_occupancy_frac", occ)
+        self.memory.observe_live(snap.get("live_bytes", 0.0),
+                                 snap.get("capacity_bytes", 0.0),
+                                 snap.get("live_tokens", 0))
+
+    def memory_plan_predicted(self, plan_key: str, **fields) -> None:
+        """``plan_memory_parts``' per-component prediction (GB fields)."""
+        self.memory.predict(plan_key, **fields)
+
+    def memory_plan_allocated(self, plan_key: str, **fields) -> None:
+        """The deployment's REAL allocation, same components/units."""
+        self.memory.allocated(plan_key, **fields)
+
     # ---- snapshot / export --------------------------------------------
     def snapshot(self) -> Dict:
         """One JSON-ready dict of everything the handle accumulated."""
         return {
             "metrics": self.metrics.snapshot(),
             "calibration": self.calibration.report(),
+            "memory": self.memory.report(),
             "workload": self.workload.features(),
             "trace": {"events": self.trace.emitted,
                       "dropped": self.trace.dropped},
@@ -268,6 +309,8 @@ class Telemetry:
                                 "snapshot": self.metrics.snapshot()}) + "\n")
             f.write(json.dumps({"kind": "calibration",
                                 "report": self.calibration.report()}) + "\n")
+            f.write(json.dumps({"kind": "memory",
+                                "report": self.memory.report()}) + "\n")
             f.write(json.dumps({"kind": "workload",
                                 "snapshot": self.workload.snapshot()}) + "\n")
             if self.store is not None:
@@ -357,6 +400,15 @@ class NullTelemetry:
         return None
 
     def record_plan_measured(self, *a, **k):
+        return None
+
+    def kv_usage(self, *a, **k):
+        return None
+
+    def memory_plan_predicted(self, *a, **k):
+        return None
+
+    def memory_plan_allocated(self, *a, **k):
         return None
 
     def snapshot(self):

@@ -35,20 +35,25 @@ from .simulator import (
     HEAVY_OPS,
     _step_flops,
     _step_param_bytes,
-    plan_memory_bytes,
+    compose_stage_parts,
+    plan_memory_parts,
     step_state_bytes,
 )
-
-_KV_BUFS = frozenset({"k", "v", "k_scale", "v_scale"})
 
 
 def _stage_kv_bytes(plan) -> float:
     """Local committed-KV bytes (k/v + int8 scales) of a stage plan — the
     per-macro-step cache read bound (err-high: counts the full registered
     capacity, not the instantaneous live prefix, consistent with
-    ``plan_memory_bytes``'s reject-safe contract)."""
+    ``plan_memory_bytes``'s reject-safe contract).  The buffer-name set is
+    the allocator's ``KV_BUFFER_NAMES`` — one vocabulary for the search's
+    KV-stream pricing, admission headroom, and the memory ledger
+    (imported lazily: search must stay importable without the serve
+    stack)."""
+    from ..serve.kv_allocator import KV_BUFFER_NAMES
+
     return sum(
-        step_state_bytes(step, plan.mesh, names=_KV_BUFS)
+        step_state_bytes(step, plan.mesh, names=KV_BUFFER_NAMES)
         for step in plan.steps if not step.is_parallel
     )
 
@@ -360,6 +365,7 @@ def search_serve_plan(
     s_mem = store.scale_for("memory_gb") if store else 1.0
 
     candidates: Dict[str, Dict] = {}
+    raw_parts_by_plan: Dict[str, Dict] = {}
     best = None
     for tp in range(1, n_chips + 1):
         if n_chips % tp or kv_heads % tp:
@@ -382,11 +388,20 @@ def search_serve_plan(
         strategy = tensor_parallel_strategy(graph, ("tp",), mesh) \
             if tp > 1 else {}
         plans = build_stage_plans(graph, split, strategy, [mesh] * pp)
-        mem = [plan_memory_bytes(p, training=False) for p in plans]
+        parts = [plan_memory_parts(p, training=False) for p in plans]
+        mem = [pt["total"] for pt in parts]
+        # per-component composition across stages (compose_stage_parts —
+        # the SAME composition publish_memory records on the deployment
+        # side, so the memory ledger reconciles like against like and
+        # weights-model and KV-model errors calibrate independently)
+        raw_parts = compose_stage_parts(parts)  # bytes
+        raw_parts_by_plan[f"tp{tp}_pp{pp}"] = raw_parts
         entry = {
             "tp": tp, "pp": pp,
             "per_stage_gb": [round(b / 1e9, 3) for b in mem],
             "fits": max(mem) <= cap,
+            "memory_parts_gb": {k: round(v / 1e9, 4)
+                                for k, v in raw_parts.items()},
         }
         bbytes = _boundary_bytes(graph, split)
         by_m = {}
@@ -441,6 +456,8 @@ def search_serve_plan(
         )
     best["candidates"] = candidates
     best["plan_key"] = f"tp{best['tp']}_pp{best['pp']}_m{best['n_micro']}"
+    best["memory_parts_gb"] = \
+        candidates[f"tp{best['tp']}_pp{best['pp']}"]["memory_parts_gb"]
     if feats:
         best["workload"] = feats
     if store is not None:
@@ -454,6 +471,18 @@ def search_serve_plan(
             memory_gb=round(max(best["per_stage_gb"]) * s_mem, 4),
             ttft_ms=best.get("ttft_ms"),
         )
+        # byte-side ledger: RAW per-component parts, unscaled AND
+        # unrounded (the memory ledger measures model-vs-reality, so
+        # calibration must not pre-correct what it is trying to estimate,
+        # and the display rounding in memory_parts_gb would zero out
+        # sub-0.1MB components or disagree with the unrounded values
+        # publish_memory records under the same plan key; the time ledger
+        # above records the SCALED memory_gb the ranking actually used)
+        from ..obs.memory import publish_predicted_parts
+
+        publish_predicted_parts(
+            telemetry, best["plan_key"],
+            raw_parts_by_plan[f"tp{best['tp']}_pp{best['pp']}"])
     return best
 
 

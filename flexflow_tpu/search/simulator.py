@@ -204,6 +204,24 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
     search uses it to REJECT plans, so erring high only costs optimality,
     never an OOM.
     """
+    return plan_memory_parts(plan, training=training)["total"]
+
+
+def plan_memory_parts(plan: Plan, training: bool = True) -> Dict[str, float]:
+    """:func:`plan_memory_bytes` decomposed per component (same arithmetic,
+    so the parts always sum to the total the capacity gate uses)::
+
+        {"weights": ..., "kv_state": ..., "transient": ..., "total": ...}
+
+    ``weights`` = local param bytes (×4 training, int8 values+scales when
+    annotated); ``kv_state`` = registered serve-state buffers (KV caches +
+    spec buffers, sharded by the plan's own head-axis config);
+    ``transient`` = stored activations (every output when training, the
+    largest single transient for inference).  The decomposition is what
+    the memory ledger (obs/memory.py) reconciles component-by-component
+    against the REAL allocation, so a weights-model error and a KV-model
+    error calibrate independently instead of blurring into one total.
+    """
     mesh = plan.mesh
     params = 0.0
     acts = []
@@ -247,7 +265,26 @@ def plan_memory_bytes(plan: Plan, training: bool = True) -> float:
             )
         state += step_state_bytes(step, mesh)
     act = sum(acts) if training else max(acts, default=0)
-    return params + act + state
+    return {"weights": params, "kv_state": state, "transient": act,
+            "total": params + act + state}
+
+
+def compose_stage_parts(parts) -> Dict[str, float]:
+    """Per-device composition of per-stage :func:`plan_memory_parts`
+    dicts (one entry per pipeline stage; a single-plan deployment passes
+    a one-element list): each component's max across stages — components
+    may bind on different chips — plus ``static`` = weights + kv_state
+    composed per stage FIRST, so it is a real binding chip's allocatable
+    share.  THE one composition every predicted-side memory-ledger
+    emitter shares (``search_serve_plan`` and the managers'
+    ``publish_memory``), so the ledger can never receive
+    differently-composed values under one plan key.  Bytes in, bytes
+    out."""
+    return {
+        **{c: max(p[c] for p in parts)
+           for c in ("weights", "kv_state", "transient", "total")},
+        "static": max(p["weights"] + p["kv_state"] for p in parts),
+    }
 
 
 def simulate(

@@ -149,6 +149,25 @@ class LLM:
             return None
         return self.rm.plan_health.check()
 
+    def memory_report(self):
+        """The deployment's byte-side view NOW: the
+        :class:`~flexflow_tpu.serve.kv_allocator.KVAllocator`'s live
+        occupancy/headroom/fragmentation snapshot plus, when a telemetry
+        handle was attached at :meth:`compile` time, the memory ledger's
+        predicted-vs-allocated HBM reconciliation (see ``obs/memory.py``).
+        None before :meth:`compile`."""
+        if self.im is None:
+            return None
+        # through the manager's view, not the target allocator directly —
+        # a spec deployment's manager combines target + draft, matching
+        # the exported gauges
+        report = {"kv": (self.rm.kv_snapshot() if self.rm is not None
+                         else self.im.kv.snapshot())}
+        tel = getattr(self.rm, "telemetry", None) if self.rm else None
+        if tel is not None and getattr(tel, "enabled", False):
+            report["ledger"] = tel.memory.report()
+        return report
+
     # ------------------------------------------------------------------
     def generate(
         self,

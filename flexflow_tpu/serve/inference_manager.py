@@ -21,6 +21,11 @@ from ..core.interpreter import build_forward
 from ..core.pcg import PCG
 from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
+from .kv_allocator import (  # noqa: F401 — re-exported for compat
+    KVAllocator,
+    StageKV,
+    allocate_attention_state,
+)
 from .ops import IncMultiHeadSelfAttention
 
 
@@ -164,46 +169,6 @@ def mark_gated_lm_head(graph, out_tids, max_requests) -> bool:
             node.op.cost_logit_rows = max_requests
             marked = True
     return marked
-
-
-def allocate_attention_state(nodes, strategy, mesh, max_requests,
-                             max_seq_len, max_spec_tokens=0,
-                             always_place=False):
-    """Allocate the KV/spec cache buffers for the attention ops in
-    ``nodes`` — the single source of the cache layout shared by the
-    single-plan manager and the per-stage allocator of pipeline-parallel
-    serving (so the seq-pad rule and buffer name set cannot diverge from
-    the bit-identity contract the pp tests pin).
-
-    The k/v (+ int8 scale) seq dim is rounded up to a lane-width (128)
-    multiple so the Pallas kernels always get a dividing power-of-two
-    block; extra slots sit beyond every mask, and the int8 scale buffers
-    share the caches' seq dim so they pad identically.
-
-    ``always_place``: commit buffers to ``mesh`` even when it is a single
-    device — per-stage KV residency is the capacity contract of PP serving
-    (the default only places on multi-device meshes, matching the
-    single-plan manager's historical behavior).
-    """
-    state: Dict[str, Any] = {}
-    for node in nodes:
-        op = node.op
-        if not isinstance(op, IncMultiHeadSelfAttention):
-            continue
-        head_axes = tuple(strategy.get(node.name, {}).get("head", ()))
-        specs = op.state_specs(max_requests, max_seq_len, max_spec_tokens,
-                               head_axes)
-        bufs = {}
-        for name, (shape, dt, sh) in specs.items():
-            if name in ("k", "v", "k_scale", "v_scale"):
-                s_pad = -(-shape[2] // 128) * 128
-                shape = shape[:2] + (s_pad,) + shape[3:]
-            arr = jnp.zeros(shape, jnp.dtype(dt))
-            if always_place or (mesh is not None and mesh.size > 1):
-                arr = jax.device_put(arr, sh.named_sharding(mesh))
-            bufs[name] = arr
-        state[node.name] = bufs
-    return state
 
 
 def pick_prefill_tile(max_tokens_per_batch: int, max_seq_len: int) -> int:
@@ -373,7 +338,15 @@ class InferenceManager:
         self._fwd = build_forward(self.plan, mode="spmd")
         self._token_tid = model.graph.input_tids[0]
         self.params = None
-        self.state = None
+        # KV-cache ownership lives in the allocator (serve/kv_allocator.py)
+        # — admission control, preemption pricing, and the memory ledger
+        # all consult THIS object; ``self.state`` is a delegating property,
+        # so the jitted step's donate/re-bind cycle is unchanged.
+        self.kv = KVAllocator(
+            [StageKV(model.graph.nodes, strategy, self.plan.mesh,
+                     max_requests, max_seq_len, max_spec_tokens)],
+            max_requests, max_seq_len,
+        )
         # Pallas decode/tree kernels: replace the cache-row-gather attention.
         # "auto" = on for TPU backends; under TP the attention op wraps the
         # kernel in shard_map over the kv-head axis (IncMultiHeadSelfAttention
@@ -454,6 +427,30 @@ class InferenceManager:
     def gate_lm_head(self, value) -> None:
         self._gate_lm_head = bool(value)
 
+    @property
+    def state(self):
+        """The KV-cache buffers, owned by the allocator.  The property
+        keeps the historical API: the jitted step takes ``self.state``
+        (donated) and the result re-binds it, with the allocator as the
+        one place the buffers live."""
+        return self.kv.state
+
+    @state.setter
+    def state(self, value) -> None:
+        self.kv.state = value
+
+    @property
+    def plan_key(self) -> str:
+        """This deployment's coordinates in the serve search's
+        ``tp{t}_pp{p}_m{m}`` convention (single-plan: pp=1, m=1)."""
+        tp = 1
+        mesh = self.plan.mesh
+        if mesh is not None:
+            shape = dict(mesh.shape)
+            for a in self.tp_axes:
+                tp *= shape.get(a, 1)
+        return f"tp{tp}_pp1_m1"
+
     # ------------------------------------------------------------------
     def init_operators_inference(self, params=None, rng=None, dtype=None):
         """Initialize params (random if none given) and allocate KV caches.
@@ -467,13 +464,48 @@ class InferenceManager:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
             params = init_params(self.model.graph, self.plan, rng, dtype=dtype)
         self.params = params
-        self.state = self.allocate_kv_cache()
+        self.allocate_kv_cache()
         return self
 
     def allocate_kv_cache(self):
-        return allocate_attention_state(
-            self.model.graph.nodes, self.strategy, self.plan.mesh,
-            self.max_requests, self.max_seq_len, self.max_spec_tokens,
+        state = self.kv.allocate()
+        self.kv.reset_attribution()
+        return state
+
+    def publish_memory(self, telemetry, key: Optional[str] = None) -> None:
+        """Record this deployment's predicted-vs-allocated HBM into the
+        handle's memory ledger (obs/memory.py): predicted =
+        ``plan_memory_parts`` over the compiled plan (the same arithmetic
+        the serve search gates with), allocated = the REAL parameter and
+        KV-buffer bytes (int8 values+scales and lane padding included).
+        ``key`` overrides the ledger plan key — co-resident deployments
+        (the spec draft model) must not collide with the target's record
+        when both run the same tp/pp shape.  Host-side accounting only;
+        no-op for a disabled handle or before the caches are allocated."""
+        if telemetry is None or not getattr(telemetry, "enabled", False):
+            return
+        from ..obs.memory import publish_predicted_parts
+        from ..search.simulator import compose_stage_parts, plan_memory_parts
+
+        key = key or self.plan_key
+        # static_gb = the statically-allocatable share (weights + KV) —
+        # the component the allocated side can actually be compared to;
+        # total_gb keeps the transient and stays one-sided (nothing ever
+        # "allocates" a transient, so reconciling it would book the
+        # activation share as model error)
+        publish_predicted_parts(
+            telemetry, key,
+            compose_stage_parts([plan_memory_parts(self.plan,
+                                                   training=False)]))
+        if self.state is None:
+            return
+        from .kv_allocator import params_nbytes
+
+        w = params_nbytes(self.params)
+        kv = self.kv.allocated_bytes(kv_only=False, per_device=True)
+        telemetry.memory_plan_allocated(
+            key, weights_gb=w / 1e9, kv_gb=kv / 1e9,
+            static_gb=(w + kv) / 1e9,
         )
 
     # ------------------------------------------------------------------

@@ -1,0 +1,360 @@
+"""KVAllocator: the single owner of the serving KV-cache buffers.
+
+Through r9 the cache buffers were InferenceManager attributes and every
+consumer re-derived its own view of them: admission control walked the raw
+buffer shapes (``resilience.kv_bytes_per_token``), preemption released
+slots it never priced, and ``plan_memory_bytes`` predicted a capacity
+nothing ever reconciled against what HBM actually held.  vLLM (Kwon et
+al., SOSP'23) showed that KV accounting at sub-request granularity is what
+turns memory from a cliff into a managed resource — this module is that
+accounting layer for the slot-contiguous cache (and the interface the
+ROADMAP's paged/prefix-shared KV item will re-implement with a block
+table behind the same API):
+
+* :class:`StageKV` — buffers of ONE compiled plan (the single-plan
+  :class:`~flexflow_tpu.serve.inference_manager.InferenceManager`, or one
+  pipeline stage of the
+  :class:`~flexflow_tpu.serve.pp.PipelinedInferenceManager`): allocation
+  via :func:`allocate_attention_state` (the one cache-layout function),
+  plus the byte arithmetic read off the REAL allocated arrays.
+* :class:`KVAllocator` — the deployment-level front: composes the
+  per-stage instances, owns the per-request slot→bytes attribution
+  (``bind`` at slot assignment, ``observe`` with live token counts per
+  serve tick, ``release`` on EVERY terminal outcome and preemption), and
+  emits the live-side memory telemetry (``kv_occupancy_frac``,
+  ``kv_headroom_bytes``, high-watermark, slot fragmentation) through the
+  shared :class:`~flexflow_tpu.obs.telemetry.Telemetry` handle.
+
+Everything here is host-side bookkeeping over buffer metadata — the
+buffers themselves are the same arrays the jitted step donates, so owning
+them here cannot change compiled executables or their outputs
+(bit-identity with the memory layer on or off is pinned by
+tests/test_kv_allocator.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# the committed-KV buffer names (k/v planes and, under int8 KV, their f32
+# scale planes) — THE byte-accounting vocabulary every consumer shares
+# (admission headroom, the serve search's KV-stream pricing, the ledger)
+KV_BUFFER_NAMES = frozenset({"k", "v", "k_scale", "v_scale"})
+
+
+def per_device_nbytes(arr) -> float:
+    """Bytes ONE device holds of a (possibly sharded) array — the worst
+    device's share, so replicated arrays count full size and sharded ones
+    their largest shard sum.  The per-device basis is what reconciles the
+    real allocation against ``plan_memory_bytes``'s per-device contract."""
+    try:
+        shards = arr.addressable_shards
+    except AttributeError:
+        return float(getattr(arr, "nbytes", 0))
+    if not shards:
+        return float(arr.nbytes)
+    by_dev: Dict[Any, float] = {}
+    for s in shards:
+        by_dev[s.device] = by_dev.get(s.device, 0.0) + s.data.nbytes
+    return max(by_dev.values())
+
+
+def params_nbytes(params) -> float:
+    """Per-device bytes of a serve param tree (the allocated-weights side
+    of the memory ledger; int8 values + f32 scales count as stored)."""
+    total = 0.0
+    for group in (params or {}).values():
+        for arr in group.values():
+            total += per_device_nbytes(arr)
+    return total
+
+
+def allocate_attention_state(nodes, strategy, mesh, max_requests,
+                             max_seq_len, max_spec_tokens=0,
+                             always_place=False):
+    """Allocate the KV/spec cache buffers for the attention ops in
+    ``nodes`` — the single source of the cache layout shared by the
+    single-plan manager and the per-stage allocator of pipeline-parallel
+    serving (so the seq-pad rule and buffer name set cannot diverge from
+    the bit-identity contract the pp tests pin).
+
+    The k/v (+ int8 scale) seq dim is rounded up to a lane-width (128)
+    multiple so the Pallas kernels always get a dividing power-of-two
+    block; extra slots sit beyond every mask, and the int8 scale buffers
+    share the caches' seq dim so they pad identically.
+
+    ``always_place``: commit buffers to ``mesh`` even when it is a single
+    device — per-stage KV residency is the capacity contract of PP serving
+    (the default only places on multi-device meshes, matching the
+    single-plan manager's historical behavior).
+    """
+    from .ops import IncMultiHeadSelfAttention
+
+    state: Dict[str, Any] = {}
+    for node in nodes:
+        op = node.op
+        if not isinstance(op, IncMultiHeadSelfAttention):
+            continue
+        head_axes = tuple(strategy.get(node.name, {}).get("head", ()))
+        specs = op.state_specs(max_requests, max_seq_len, max_spec_tokens,
+                               head_axes)
+        bufs = {}
+        for name, (shape, dt, sh) in specs.items():
+            if name in KV_BUFFER_NAMES:
+                s_pad = -(-shape[2] // 128) * 128
+                shape = shape[:2] + (s_pad,) + shape[3:]
+            arr = jnp.zeros(shape, jnp.dtype(dt))
+            if always_place or (mesh is not None and mesh.size > 1):
+                arr = jax.device_put(arr, sh.named_sharding(mesh))
+            bufs[name] = arr
+        state[node.name] = bufs
+    return state
+
+
+class StageKV:
+    """Buffers of one compiled plan (a whole single-plan deployment, or
+    one pipeline stage).  Holds the live state dict the jitted step
+    donates and re-binds, plus the byte arithmetic over it."""
+
+    def __init__(self, nodes, strategy, mesh, max_requests: int,
+                 max_seq_len: int, max_spec_tokens: int = 0,
+                 always_place: bool = False, label: str = "plan"):
+        self.nodes = list(nodes)
+        self.strategy = strategy or {}
+        self.mesh = mesh
+        self.max_requests = max_requests
+        self.max_seq_len = max_seq_len
+        self.max_spec_tokens = max_spec_tokens
+        self.always_place = always_place
+        self.label = label
+        self.state: Optional[Dict[str, Dict]] = None
+
+    def allocate(self) -> Dict[str, Dict]:
+        """(Re)allocate zeroed cache buffers; returns the state dict."""
+        self.state = allocate_attention_state(
+            self.nodes, self.strategy, self.mesh, self.max_requests,
+            self.max_seq_len, self.max_spec_tokens,
+            always_place=self.always_place,
+        )
+        return self.state
+
+    # ---- byte accounting over the ALLOCATED arrays --------------------
+    def allocated_bytes(self, kv_only: bool = True,
+                        per_device: bool = False) -> float:
+        """Bytes of the allocated serve-state buffers (``kv_only``
+        restricts to the committed k/v (+scale) planes; False adds the
+        spec-tree buffers too).  ``per_device`` counts one device's share
+        (the ledger's reconciliation basis against per-device
+        ``plan_memory_bytes``); the default is global bytes, matching the
+        admission gate's historical accounting.  0.0 before
+        :meth:`allocate`."""
+        if not self.state:
+            return 0.0
+        total = 0.0
+        for bufs in self.state.values():
+            for name, arr in bufs.items():
+                if kv_only and name not in KV_BUFFER_NAMES:
+                    continue
+                total += per_device_nbytes(arr) if per_device else arr.nbytes
+        return total
+
+    def bytes_per_token(self) -> Optional[float]:
+        """Committed-KV bytes one request's cache position costs across
+        this plan's attention ops — THE shape walk admission control,
+        preemption pricing, and the memory ledger all share.
+
+        Buffers are ``[max_requests+1, heads, seq, dim]``, so the
+        per-request-token price divides by the REAL request rows as well
+        as the seq axis; the pad-scratch row's bytes amortize over the
+        real rows, so ``per_tok * max_requests * max_seq_len``
+        approximates the full cache allocation (scratch row priced in,
+        lane padding beyond ``max_seq_len`` not).  None before
+        :meth:`allocate`."""
+        if not self.state:
+            return None
+        total = 0.0
+        for bufs in self.state.values():
+            for name, arr in bufs.items():
+                if name in KV_BUFFER_NAMES:
+                    rows = max(arr.shape[0] - 1, 1)  # minus the scratch row
+                    total += arr.nbytes / (rows * arr.shape[2])
+        return total or None
+
+
+class KVAllocator:
+    """Deployment-level KV ownership: per-stage buffers + per-request
+    attribution + live-side memory telemetry.
+
+    ``stages``: one :class:`StageKV` per compiled plan — a single-plan
+    manager passes one; ``PipelinedInferenceManager`` one per pipeline
+    stage (per-stage KV residency is its capacity contract).
+
+    Attribution protocol (driven by the RequestManager):
+
+    * :meth:`bind` when a request takes a slot;
+    * :meth:`observe` once per serve tick with every live slotted
+      request's cache depth — updates per-request peaks, the live
+      high-watermark, and (telemetry enabled) the occupancy/headroom/
+      fragmentation gauges;
+    * :meth:`release` on EVERY path a request leaves its slot —
+      completion, cancel, timeout, failure, preemption — returning the
+      bytes attributed to the binding (peak positions held × bytes per
+      token), so no terminal outcome can leak attribution
+      (tests/test_kv_allocator.py pins all of r9's outcomes).
+    """
+
+    def __init__(self, stages: Sequence[StageKV], max_requests: int,
+                 max_seq_len: int):
+        self.stages = list(stages)
+        self.max_requests = max_requests
+        self.max_seq_len = max_seq_len
+        self._live: Dict[int, int] = {}   # rid -> last observed cache depth
+        self._peak: Dict[int, int] = {}   # rid -> peak depth this binding
+        self.hwm_tokens = 0
+        self.hwm_bytes = 0.0
+
+    # ---- buffer ownership ---------------------------------------------
+    def allocate(self):
+        """(Re)allocate every stage's buffers (zeroed).  Returns the
+        single-plan state dict, or the per-stage list for pp."""
+        states = [s.allocate() for s in self.stages]
+        return states[0] if len(states) == 1 else states
+
+    @property
+    def state(self):
+        """Single-plan convenience view (the one stage's state dict); pp
+        callers address ``stages[i].state`` directly."""
+        return self.stages[0].state
+
+    @state.setter
+    def state(self, value):
+        self.stages[0].state = value
+
+    def reset_attribution(self) -> None:
+        """Forget all request attribution + watermarks (new serving
+        session over the same buffers; rids restart from 0)."""
+        self._live.clear()
+        self._peak.clear()
+        self.hwm_tokens = 0
+        self.hwm_bytes = 0.0
+
+    # ---- the ONE headroom arithmetic ----------------------------------
+    def bytes_per_token(self) -> Optional[float]:
+        """Committed-KV bytes one request-token costs across ALL stages —
+        None until every stage's caches are allocated, and None again if a
+        caller drops them (``im.state = None`` frees HBM between bench
+        runs); always read off the LIVE buffers, never cached, so the
+        price can't outlive the allocation it describes."""
+        parts = [s.bytes_per_token() for s in self.stages]
+        if any(p is None for p in parts):
+            return None
+        return sum(parts) or None
+
+    @property
+    def capacity_tokens(self) -> int:
+        """Position capacity of the slot-contiguous cache."""
+        return self.max_requests * self.max_seq_len
+
+    def capacity_bytes(self) -> float:
+        """Byte capacity priced at :meth:`bytes_per_token` (falls back to
+        token-slot units — 1.0/token — before caches are allocated, the
+        same degradation the admission gate historically had)."""
+        return self.capacity_tokens * (self.bytes_per_token() or 1.0)
+
+    def allocated_bytes(self, kv_only: bool = True,
+                        per_device: bool = False) -> float:
+        """Bytes actually held by the allocated cache buffers (lane
+        padding and scratch rows included) across all stages; see
+        :meth:`StageKV.allocated_bytes` for the ``per_device`` basis."""
+        return sum(s.allocated_bytes(kv_only=kv_only, per_device=per_device)
+                   for s in self.stages)
+
+    # ---- per-request attribution --------------------------------------
+    def bind(self, rid: int) -> None:
+        """A request took a slot (admission or preemption-readmission)."""
+        self._live.setdefault(int(rid), 0)
+        self._peak.setdefault(int(rid), 0)
+
+    def observe(self, usage: Dict[int, int], telemetry=None) -> Dict:
+        """One serve tick's live cache depths (``rid -> tokens`` for every
+        slotted PREFILLING/DECODING request).  Updates peaks + watermarks
+        and, when a live telemetry handle is given, publishes the gauge
+        set; returns the computed snapshot either way."""
+        self._live = {int(r): int(t) for r, t in usage.items()}
+        for rid, t in self._live.items():
+            if t > self._peak.get(rid, 0):
+                self._peak[rid] = t
+        per_tok = self.bytes_per_token()  # ONE buffer walk per tick
+        live = sum(self._live.values())
+        live_bytes = live * per_tok if per_tok else 0.0
+        if live > self.hwm_tokens:
+            self.hwm_tokens = live
+        if live_bytes > self.hwm_bytes:
+            self.hwm_bytes = live_bytes
+        snap = self.snapshot(_per_tok=per_tok, _live=live)
+        if telemetry is not None and getattr(telemetry, "enabled", False):
+            telemetry.kv_usage(snap)
+        return snap
+
+    def snapshot(self, _per_tok: Optional[float] = None,
+                 _live: Optional[int] = None) -> Dict:
+        """The current occupancy/headroom/fragmentation view over the
+        last-observed depths — pure read (no peak/watermark updates, no
+        telemetry); :meth:`observe` is the mutating per-tick entry and
+        passes its already-computed walk/sum in so the hot path prices
+        the buffers exactly once per tick."""
+        per_tok = self.bytes_per_token() if _per_tok is None else _per_tok
+        live = sum(self._live.values()) if _live is None else _live
+        live_bytes = live * per_tok if per_tok else 0.0
+        cap_b = self.capacity_tokens * (per_tok or 1.0)
+        bound = len(self._live)
+        return {
+            "live_tokens": live,
+            "live_bytes": live_bytes,
+            "capacity_tokens": self.capacity_tokens,
+            "capacity_bytes": cap_b,
+            "headroom_bytes": cap_b - (live_bytes if per_tok else live),
+            "occupancy_frac": (live / self.capacity_tokens
+                               if self.capacity_tokens else 0.0),
+            # slot fragmentation: each bound slot reserves max_seq_len
+            # contiguous positions of which only the live prefix is
+            # occupied — the allocated-but-idle share the paged-KV item
+            # exists to reclaim
+            "fragmentation_frac": (
+                1.0 - live / (bound * self.max_seq_len)
+                if bound and self.max_seq_len else 0.0),
+            "bound_slots": bound,
+            "hwm_tokens": self.hwm_tokens,
+            "hwm_bytes": self.hwm_bytes,
+        }
+
+    def live_tokens(self) -> int:
+        return sum(self._live.values())
+
+    def live_requests(self) -> int:
+        """Slotted requests currently holding cache (the OOM-risk
+        projection multiplies each by the expected remaining output)."""
+        return len(self._live)
+
+    def release(self, rid: int, tokens: Optional[int] = None) -> float:
+        """The request left its slot (ANY terminal outcome, or a
+        preemption eviction).  ``tokens`` is its final cache depth when
+        the caller knows it (a request can admit and finish within one
+        tick, before any :meth:`observe`); attribution is the PEAK depth
+        the binding reached × bytes per token.  Safe (0.0) for rids that
+        never bound — a rejected request holds no cache."""
+        rid = int(rid)
+        peak = self._peak.pop(rid, 0)
+        last = self._live.pop(rid, 0)
+        if tokens is not None:
+            peak = max(peak, int(tokens))
+        peak = max(peak, last)
+        return peak * (self.bytes_per_token() or 0.0)
+
+    def attributed_rids(self) -> List[int]:
+        """Rids currently holding attribution — empty once every request
+        reached a terminal outcome (the no-leak contract)."""
+        return sorted(set(self._live) | set(self._peak))

@@ -49,13 +49,13 @@ from ..core.pcg import PCG
 from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
 from .inference_manager import (
-    allocate_attention_state,
     mark_gated_lm_head,
     pick_prefill_tile,
     register_serve_capacities,
     sample_tokens,
     tensor_parallel_strategy,
 )
+from .kv_allocator import KVAllocator, StageKV, params_nbytes
 from .ops import IncMultiHeadSelfAttention
 
 
@@ -175,8 +175,19 @@ class _Stage:
 
         self.replicated = NamedSharding(mesh, P())
         self.params: Optional[Dict] = None
-        self.state: Optional[Dict] = None
+        # per-stage KV ownership (serve/kv_allocator.py): the manager binds
+        # a StageKV per stage; ``state`` delegates so the async dispatch
+        # loop's donate/re-bind cycle is unchanged
+        self.kv: Optional[StageKV] = None
         self.step = None  # bound by the manager (closes over its flags)
+
+    @property
+    def state(self) -> Optional[Dict]:
+        return self.kv.state if self.kv is not None else None
+
+    @state.setter
+    def state(self, value) -> None:
+        self.kv.state = value
 
 
 class PipelinedInferenceManager:
@@ -302,6 +313,19 @@ class PipelinedInferenceManager:
         ]
         self.stage_plans = plans
         self._token_tid = model.graph.input_tids[0]
+        # per-stage KVAllocator instances under one deployment-level front:
+        # each stage owns ITS caches (always_place — per-stage KV residency
+        # is the capacity contract), while admission/preemption/the memory
+        # ledger consult the composed allocator exactly like the
+        # single-plan manager's.
+        stage_kvs = [
+            StageKV(stage.nodes, strategy, stage.mesh, max_requests,
+                    max_seq_len, 0, always_place=True, label=f"stage{s}")
+            for s, stage in enumerate(self.stages)
+        ]
+        for stage, skv in zip(self.stages, stage_kvs):
+            stage.kv = skv
+        self.kv = KVAllocator(stage_kvs, max_requests, max_seq_len)
 
         backend = jax.default_backend()
         self.use_pallas = (backend == "tpu") if use_pallas == "auto" \
@@ -431,17 +455,53 @@ class PipelinedInferenceManager:
         return self
 
     def allocate_kv_cache(self):
-        for stage in self.stages:
-            # always_place: committed to the stage's mesh even when it is
-            # one device — per-stage KV residency is the capacity contract
-            stage.state = allocate_attention_state(
-                stage.nodes, self.strategy, stage.mesh,
-                self.max_requests, self.max_seq_len, 0, always_place=True,
-            )
+        # the allocator owns every stage's buffers (always_place was baked
+        # into each StageKV at construction — per-stage KV residency is
+        # the capacity contract of pp serving)
+        self.kv.allocate()
+        self.kv.reset_attribution()
         return self.state
 
     def reset(self):
         self.allocate_kv_cache()
+
+    @property
+    def plan_key(self) -> str:
+        """Deployment coordinates in the serve search's convention."""
+        return f"tp{self.tp}_pp{self.pp}_m{self.n_micro}"
+
+    def publish_memory(self, telemetry, key=None) -> None:
+        """Predicted-vs-allocated HBM per component into the handle's
+        memory ledger — per-DEVICE basis, the SAME composition on both
+        sides: per-component max across stages (each component's worst
+        chip; components may bind on different stages, so the per-pair
+        ratios stay meaningful even when no single chip holds every max).
+        ``static_gb`` (weights + KV, the allocatable share) is composed
+        per STAGE first, so it is a real binding chip's number.  See
+        :meth:`InferenceManager.publish_memory` (also for ``key``)."""
+        if telemetry is None or not getattr(telemetry, "enabled", False):
+            return
+        from ..obs.memory import publish_predicted_parts
+        from ..search.simulator import compose_stage_parts, plan_memory_parts
+
+        key = key or self.plan_key
+        publish_predicted_parts(
+            telemetry, key,
+            compose_stage_parts([plan_memory_parts(p, training=False)
+                                 for p in self.stage_plans]))
+        if self.stages[0].state is None:
+            return
+        per_stage = [
+            (params_nbytes(stage.params),
+             stage.kv.allocated_bytes(kv_only=False, per_device=True))
+            for stage in self.stages
+        ]
+        telemetry.memory_plan_allocated(
+            key,
+            weights_gb=max(w for w, _ in per_stage) / 1e9,
+            kv_gb=max(kv for _, kv in per_stage) / 1e9,
+            static_gb=max(w + kv for w, kv in per_stage) / 1e9,
+        )
 
     # ------------------------------------------------------------------
     def _microbatches(self, bc):

@@ -84,6 +84,11 @@ class Request:
     # ``seq_len`` needs while the recompute prefill is in flight.
     prefill_src: Optional[List[int]] = None
     n_prefed: int = 0
+    # memory observability (serve/kv_allocator.py): peak committed-KV bytes
+    # this request held across its slot bindings — stamped by the
+    # allocator's release() on every slot-leaving path, carried on finish
+    # telemetry and serving records
+    kv_bytes: float = 0.0
 
     @property
     def prefill_tokens(self) -> List[int]:
@@ -141,6 +146,17 @@ class RequestManager:
         self.telemetry = telemetry_or_null(telemetry)
         im.telemetry = self.telemetry
         self._tstamps: Dict[int, Dict[str, float]] = {}  # rid -> stamps
+        # KV ownership (serve/kv_allocator.py): a fresh manager restarts
+        # rids from 0, so any attribution a previous manager left on a
+        # shared/cached im must not alias the new rid space; and the
+        # deployment's predicted-vs-allocated HBM is recorded into the
+        # handle's memory ledger once, here (host-side only — pinned
+        # bit-identical with the layer on or off).
+        kv = getattr(im, "kv", None)
+        if kv is not None:
+            kv.reset_attribution()
+        if self.telemetry.enabled and hasattr(im, "publish_memory"):
+            im.publish_memory(self.telemetry)
         # resilient serving (serve/resilience.py): admission/deadline/
         # preemption/retry policy + the seeded chaos hook.  The injector is
         # synced onto the InferenceManager like the telemetry handle (same
@@ -166,7 +182,6 @@ class RequestManager:
         # the retry backoff's wait (injectable for the same reason)
         self.clock = clock or _time.perf_counter
         self._sleep = _time.sleep
-        self._kv_bytes_tok: Optional[float] = None
         # plan-health monitoring (obs/plan_health.py): an attached
         # PlanHealthMonitor is polled every ``health_check_every`` serve
         # ticks (and once when a serve loop drains) — host-side arithmetic
@@ -174,7 +189,12 @@ class RequestManager:
         # change serve outputs (tests/test_plan_health.py bit-identity).
         # Recommendation-only: the monitor emits ``replan_recommended``;
         # nothing here acts on it (live migration rides a later PR).
+        # The manager's KVAllocator is handed to the monitor so its
+        # OOM-risk check prices projected KV growth against REAL headroom.
         self.plan_health = plan_health
+        if (plan_health is not None
+                and getattr(plan_health, "kv_allocator", None) is None):
+            plan_health.kv_allocator = kv
         self._health_ticks = 0
 
     def _sample_arg(self):
@@ -253,14 +273,15 @@ class RequestManager:
                     f"{self.im.max_seq_len}")
         return None
 
-    def _kv_bytes_per_token(self) -> float:
-        """Per-position committed-KV cost for the admission gate (1.0 =
-        token-slot units until the caches are allocated)."""
-        if self._kv_bytes_tok is None:
-            from .resilience import kv_bytes_per_token
+    def _kv_bytes_per_token(self) -> Optional[float]:
+        """Per-position committed-KV cost for the admission gate, or None
+        while the caches are unallocated.  Read live from the allocator
+        on every call — a cached price could disagree in UNITS with the
+        capacity arithmetic (which also degrades to token-slot units)
+        after a caller frees the buffers."""
+        from .resilience import kv_bytes_per_token
 
-            self._kv_bytes_tok = kv_bytes_per_token(self.im)
-        return self._kv_bytes_tok or 1.0
+        return kv_bytes_per_token(self.im)
 
     def _admission_reason(self, req: Request) -> Optional[str]:
         """Capacity gate: the rejection reason, or None to admit.
@@ -276,6 +297,16 @@ class RequestManager:
                     f"{res.max_pending})")
         if res.kv_gate:
             per_tok = self._kv_bytes_per_token()
+            if per_tok is None and res.kv_budget_bytes is not None:
+                # an explicit BYTE cap cannot be priced without allocated
+                # caches (the __init__ guard checked once, but a caller
+                # can free HBM later via ``im.state = None``) — gating
+                # token-slot units against a byte budget would silently
+                # admit everything, so fail SAFE and reject instead
+                return ("kv_budget_bytes is a byte cap but the KV caches "
+                        "are unallocated (no byte price); re-allocate "
+                        "caches or gate with kv_headroom_frac")
+            per_tok = per_tok or 1.0  # token-slot units for the frac gate
             live = [self.requests[r] for r in self.pending] + [
                 r for r in self._active()
                 if r.status in (RequestStatus.PREFILLING,
@@ -285,11 +316,15 @@ class RequestManager:
             # the budget: an explicit byte cap when configured (this is
             # where the per-token BYTE pricing decides — int8 vs bf16 KV
             # admit differently under the same cap), else the headroom
-            # fraction of the allocated cache's own position capacity
+            # fraction of the allocator's own byte capacity — ONE
+            # arithmetic, owned by the KVAllocator, shared with
+            # preemption pricing and the memory ledger
+            kv = getattr(self.im, "kv", None)
             cap_bytes = (res.kv_budget_bytes
                          if res.kv_budget_bytes is not None
                          else res.kv_headroom_frac
-                         * self.im.max_requests * self.im.max_seq_len
+                         * (kv.capacity_tokens if kv is not None
+                            else self.im.max_requests * self.im.max_seq_len)
                          * per_tok)
             if committed * per_tok > cap_bytes:
                 return (f"KV headroom: {committed * per_tok / 2**20:.2f}"
@@ -394,6 +429,15 @@ class RequestManager:
         if req.slot >= 0:
             self.slots[req.slot] = None
             req.slot = -1
+            # EVERY slot-leaving path — completion, cancel, timeout,
+            # failure, preemption — releases the request's KV attribution
+            # here, so no terminal outcome can leak it (pinned by
+            # tests/test_kv_allocator.py); the returned peak-bytes stamp
+            # rides finish telemetry and serving records
+            kv = getattr(self.im, "kv", None)
+            if kv is not None:
+                req.kv_bytes = max(
+                    req.kv_bytes, kv.release(req.rid, tokens=req.seq_len))
 
     def _terminate(self, req: Request, status: RequestStatus,
                    site: str = "") -> None:
@@ -579,6 +623,7 @@ class RequestManager:
                 req.slot = i
                 req.status = RequestStatus.PREFILLING
                 self.slots[i] = rid
+                self._kv_bind(rid)
                 tel = self.telemetry
                 if tel.enabled:
                     ts = self._tstamps.setdefault(rid, {})
@@ -844,7 +889,8 @@ class RequestManager:
                     req.trace_id, n_tokens=len(req.generated),
                     tpot_s=((now - first)
                             / max(len(req.generated) - 1, 1)
-                            if first is not None else None))
+                            if first is not None else None),
+                    kv_bytes=req.kv_bytes or None)
 
     # ------------------------------------------------------------------
     def _scan_steps_possible(self) -> int:
@@ -1079,6 +1125,36 @@ class RequestManager:
                 self.process_result(result, sample_points)
             self.steps += 1
 
+    def _kv_bind(self, rid: int) -> None:
+        """Attribution hook when a request takes a slot (overridden by
+        managers holding more than one deployment's caches — the spec
+        manager binds the draft model's allocator too)."""
+        kv = getattr(self.im, "kv", None)
+        if kv is not None:
+            kv.bind(rid)
+
+    def kv_snapshot(self) -> Optional[Dict]:
+        """The deployment's live KV view (pure read — see
+        :meth:`KVAllocator.snapshot`); overridden by managers holding
+        more than one deployment's caches (the spec manager returns the
+        combined target+draft view its gauges publish).  None without an
+        allocator."""
+        kv = getattr(self.im, "kv", None)
+        return kv.snapshot() if kv is not None else None
+
+    def _sync_kv(self) -> None:
+        """One per-tick snapshot of live cache depths into the allocator
+        (per-request peaks, watermarks, occupancy/headroom/fragmentation
+        gauges when telemetry is live) — host bookkeeping only."""
+        kv = getattr(self.im, "kv", None)
+        if kv is None:
+            return
+        kv.observe(
+            {r.rid: r.seq_len for r in self._active()
+             if r.status in (RequestStatus.PREFILLING,
+                             RequestStatus.DECODING)},
+            self.telemetry)
+
     def _maybe_check_health(self, force: bool = False) -> None:
         """Poll the attached plan-health monitor every
         ``health_check_every`` ticks (``force`` = loop drained: one final
@@ -1210,6 +1286,7 @@ class RequestManager:
                 self.scan_chunk = quantum if pending else saved_chunk
                 starters = prefill_starters()
                 self._serve_tick()
+                self._sync_kv()
                 self._maybe_check_health()
                 for rid in starters:
                     if self.requests[rid].prefill_offset > 0:
@@ -1227,6 +1304,9 @@ class RequestManager:
             req = self.requests[rid]
             rec["tokens"] = req.generated
             rec["outcome"] = req.outcome or OUTCOMES.get(req.status, "ok")
+            # byte-side attribution: peak committed-KV this request held
+            # (0.0 for rejected/never-slotted requests)
+            rec["kv_bytes"] = req.kv_bytes
             # ALWAYS emit the TTFT decomposition: queue wait runs from
             # arrival to prefill start (falling back to registration, then
             # arrival, when prefill never began); prefill runs from there
@@ -1255,6 +1335,7 @@ class RequestManager:
             if not self.has_work():
                 break
             self._serve_tick()
+            self._sync_kv()
             self._maybe_check_health()
         self._maybe_check_health(force=True)
         return {rid: r.generated for rid, r in self.requests.items()}

@@ -175,26 +175,16 @@ def kv_bytes_per_token(im) -> Optional[float]:
     serve graph's attention ops (k + v planes and, under int8 KV, their
     f32 scale planes).
 
-    Read off the ALLOCATED cache buffers (single-plan state or the merged
-    per-stage state of pipeline-parallel serving), so lane padding, kv
-    dtype, and sharding can never diverge from the ``plan_memory_bytes``
-    accounting that admitted the deployment.  Buffers are
-    ``[max_requests+1, heads, seq, dim]``, so the per-request-token price
-    divides by the REAL request rows as well as the seq axis; the pad-
-    scratch row's bytes amortize over the real rows, so
-    ``per_tok * max_requests * max_seq_len`` approximates the full cache
-    allocation (scratch row priced in, lane padding beyond ``max_seq_len``
-    not).  Returns None before
+    THE one owner of this arithmetic is the manager's
+    :class:`~flexflow_tpu.serve.kv_allocator.KVAllocator` — admission
+    control, preemption pricing, and the memory ledger all read the same
+    walk over the ALLOCATED buffers, so lane padding, kv dtype, and
+    sharding can never diverge between them (the r9 duplicate shape walk
+    that used to live here is deleted).  Returns None before
     ``init_operators_inference`` allocates caches — the admission gate
     then falls back to token-slot units.
     """
-    state = getattr(im, "state", None)
-    if not state:
+    kv = getattr(im, "kv", None)
+    if kv is None:
         return None
-    total = 0.0
-    for bufs in state.values():
-        for name, arr in bufs.items():
-            if name in ("k", "v", "k_scale", "v_scale"):
-                rows = max(arr.shape[0] - 1, 1)  # minus the scratch row
-                total += arr.nbytes / (rows * arr.shape[2])
-    return total or None
+    return kv.bytes_per_token()

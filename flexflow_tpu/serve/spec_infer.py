@@ -134,6 +134,102 @@ class SpecInferManager(RequestManager):
             raise ValueError(f"SSM InferenceManager needs topk >= width ({width})")
         self.macro_steps = 0
         self.llm_steps = 0
+        self._kv_hwm_tokens = 0    # combined (target + draft) watermark
+        self._kv_hwm_bytes = 0.0
+        # the draft model is a co-resident deployment: its params + KV
+        # buffers are REAL HBM, so its allocator joins the attribution
+        # protocol (reset like the target's in RequestManager.__init__)
+        # and its predicted-vs-allocated record lands in the memory
+        # ledger under its own "_draft" plan key — same tp/pp shape as
+        # the target must not collide with the target's record
+        kv_s = getattr(ssm, "kv", None)
+        if kv_s is not None:
+            kv_s.reset_attribution()
+            # the base __init__ auto-wired the plan-health monitor to the
+            # TARGET allocator; widen the auto-wiring to both caches so
+            # the OOM projection covers the draft's growth too (an
+            # explicitly-provided allocator is the caller's choice)
+            kv_l = getattr(llm, "kv", None)
+            if (self.plan_health is not None and kv_l is not None
+                    and self.plan_health.kv_allocator is kv_l):
+                self.plan_health.kv_allocator = [kv_l, kv_s]
+        if self.telemetry.enabled and hasattr(ssm, "publish_memory"):
+            ssm.publish_memory(self.telemetry,
+                               key=ssm.plan_key + "_draft")
+
+    # ------------------------------------------------------------------
+    # memory observability over TWO deployments (target + draft)
+    # ------------------------------------------------------------------
+    def _kv_bind(self, rid: int) -> None:
+        super()._kv_bind(rid)
+        kv_s = getattr(self.ssm, "kv", None)
+        if kv_s is not None:
+            kv_s.bind(rid)
+
+    def _release_slot(self, req: Request) -> None:
+        if req.slot < 0:
+            return
+        # draft share first (super() clears req.slot); spec serving has
+        # no preemption, so a request binds exactly once and the target
+        # (max-stamped by super) + draft shares sum exactly
+        kv_s = getattr(self.ssm, "kv", None)
+        draft = (kv_s.release(req.rid, tokens=req.ssm_committed)
+                 if kv_s is not None else 0.0)
+        super()._release_slot(req)
+        req.kv_bytes += draft
+
+    def _combine_snaps(self, snap: Dict, snap_s: Dict, kv_l, kv_s) -> Dict:
+        """Fold the draft allocator's snapshot into the target's: summed
+        tokens/bytes/capacity, recomputed fracs, and the manager-held
+        combined watermark — the peak of the SUMMED live stream (adding
+        the two allocators' independent all-time peaks could overstate:
+        they may peak at different ticks — and diverge from the ledger's
+        own observe_live watermark over the same summed stream).  Any
+        true observation may raise the watermark, so pure-read callers
+        (``kv_snapshot``) share this safely."""
+        for k in ("live_tokens", "live_bytes", "capacity_tokens",
+                  "capacity_bytes", "headroom_bytes"):
+            snap[k] += snap_s[k]
+        self._kv_hwm_tokens = max(self._kv_hwm_tokens, snap["live_tokens"])
+        self._kv_hwm_bytes = max(self._kv_hwm_bytes, snap["live_bytes"])
+        snap["hwm_tokens"] = self._kv_hwm_tokens
+        snap["hwm_bytes"] = self._kv_hwm_bytes
+        snap["occupancy_frac"] = (
+            snap["live_tokens"] / snap["capacity_tokens"]
+            if snap["capacity_tokens"] else 0.0)
+        reserved = (kv_l.live_requests() * kv_l.max_seq_len
+                    + kv_s.live_requests() * kv_s.max_seq_len)
+        snap["fragmentation_frac"] = (
+            1.0 - snap["live_tokens"] / reserved if reserved else 0.0)
+        return snap
+
+    def kv_snapshot(self):
+        kv_l = getattr(self.llm, "kv", None)
+        kv_s = getattr(self.ssm, "kv", None)
+        if kv_l is None or kv_s is None:
+            return super().kv_snapshot()
+        return self._combine_snaps(kv_l.snapshot(), kv_s.snapshot(),
+                                   kv_l, kv_s)
+
+    def _sync_kv(self) -> None:
+        """Observe BOTH allocators (per-deployment peaks + watermarks)
+        and publish ONE combined live view — summed tokens/bytes/
+        capacity — so the occupancy/headroom gauges and the ledger
+        watermark account the draft model's KV instead of under-reporting
+        live HBM by its whole share."""
+        kv_l = getattr(self.llm, "kv", None)
+        kv_s = getattr(self.ssm, "kv", None)
+        if kv_l is None or kv_s is None:
+            return super()._sync_kv()
+        live = [r for r in self._active()
+                if r.status in (RequestStatus.PREFILLING,
+                                RequestStatus.DECODING)]
+        snap = self._combine_snaps(
+            kv_l.observe({r.rid: r.seq_len for r in live}, None),
+            kv_s.observe({r.rid: r.ssm_committed for r in live}, None),
+            kv_l, kv_s)
+        if self.telemetry.enabled:
+            self.telemetry.kv_usage(snap)
 
     def _seq_len_needed(self, req: Request) -> int:
         # verification scores up to `depth` speculative positions past the
@@ -436,6 +532,7 @@ class SpecInferManager(RequestManager):
             self._prefill_phase()
             drafting = self._draft_phase()
             self._verify_phase(drafting)
+            self._sync_kv()  # live KV occupancy, once per macro step
             self.macro_steps += 1
         return {rid: r.generated for rid, r in self.requests.items()}
 

@@ -16,6 +16,15 @@ workload-drift score + per-dimension window means, ``drift_detected`` /
 ``replan_recommended`` events, and the CalibrationStore scales that were
 auto-applied to the search's predictions.
 
+The ``memory`` section is the byte-side view (obs/memory.py): live KV
+watermarks (``hwm_frac`` vs capacity), occupancy p50/p95, the
+``kv_*`` gauge values, per-request ``request_kv_bytes`` attribution, the
+per-component predicted-vs-allocated HBM error table (the memory
+ledger's analog of ``prediction_error`` — its ``suggested_scale`` feeds
+``MachineModel`` memory-constant calibration), and any
+``memory_pressure`` OOM-risk breach events the plan-health monitor
+emitted.
+
 A trace whose ring buffer dropped events is TRUNCATED — the summary is
 computed from what survived — so ``dropped > 0`` prints an explicit
 warning to stderr (satellite of ISSUE 6: a truncated trace must not

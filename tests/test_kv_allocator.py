@@ -1,0 +1,522 @@
+"""KVAllocator ownership + memory observability (ISSUE 8 / r12).
+
+The load-bearing contracts:
+
+* the :class:`~flexflow_tpu.serve.kv_allocator.KVAllocator` is the SINGLE
+  owner of the KV cache buffers — ``im.state`` delegates to it, and
+  ``resilience.kv_bytes_per_token`` (admission, preemption pricing) reads
+  the allocator's one shape walk, so the gate and the ledger can never
+  disagree;
+* the memory layer is host-side only: served tokens AND cache contents
+  are bit-identical with memory telemetry on vs off — single step, full
+  greedy generate, arrival-driven serving, pp2 virtual mesh, int8 KV;
+* EVERY slot-leaving path (ok / REJECTED / CANCELLED / TIMED_OUT /
+  PREEMPTED / FAILED) releases the request's attribution — no terminal
+  outcome leaks, and the peak-bytes stamp rides records/telemetry;
+* ``publish_memory`` reconciles predicted (``plan_memory_parts``) vs
+  allocated (real buffers) per component in the memory ledger;
+* the plan-health OOM-risk check projects live KV growth against the
+  allocator's headroom and emits an edge-triggered ``memory_pressure``.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import (
+    NULL_TELEMETRY,
+    PlanHealthConfig,
+    PlanHealthMonitor,
+    Telemetry,
+)
+from flexflow_tpu.serve import (
+    FaultInjector,
+    GenerationConfig,
+    RequestManager,
+    RequestStatus,
+    ResilienceConfig,
+    RetryPolicy,
+)
+from flexflow_tpu.serve.kv_allocator import KV_BUFFER_NAMES, KVAllocator
+from flexflow_tpu.serve.resilience import kv_bytes_per_token
+
+from test_resilience import TriggerClock, quiet
+from test_serve import TINY, make_im
+from test_serving_under_load import VirtualClock, poisson_arrivals
+
+
+def _vclock_tel():
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    return Telemetry(clock=Clock())
+
+
+def _states_snapshot(state):
+    return {n: {b: np.asarray(a).copy() for b, a in bufs.items()}
+            for n, bufs in state.items()}
+
+
+def _assert_states_equal(a, b):
+    assert set(a) == set(b)
+    for name in a:
+        for buf in a[name]:
+            assert np.array_equal(a[name][buf], np.asarray(b[name][buf])), \
+                f"{name}.{buf} diverged"
+
+
+# ---------------------------------------------------------------------------
+# single ownership: one buffer owner, one headroom arithmetic
+# ---------------------------------------------------------------------------
+def test_allocator_owns_state_and_the_headroom_arithmetic():
+    im = make_im(max_seq=64)
+    assert isinstance(im.kv, KVAllocator)
+    # the state property delegates: same dict object, re-bindable
+    assert im.state is im.kv.stages[0].state
+    # resilience's per-token price IS the allocator's walk (satellite:
+    # the duplicated shape-walk is deleted; admission, preemption, and
+    # the ledger share one arithmetic)
+    assert kv_bytes_per_token(im) == im.kv.bytes_per_token()
+    # and the walk matches a manual reading of the REAL buffers
+    total = 0.0
+    for bufs in im.state.values():
+        for name, arr in bufs.items():
+            if name in KV_BUFFER_NAMES:
+                total += arr.nbytes / (max(arr.shape[0] - 1, 1)
+                                       * arr.shape[2])
+    assert im.kv.bytes_per_token() == pytest.approx(total)
+    assert im.kv.capacity_bytes() == pytest.approx(
+        total * im.max_requests * im.max_seq_len)
+    # dropping the buffers (bench frees HBM via `im.state = None` between
+    # runs) must drop the price too — never a stale cached value
+    saved = im.state
+    try:
+        im.state = None
+        assert im.kv.bytes_per_token() is None
+        assert kv_bytes_per_token(im) is None
+    finally:
+        im.state = saved
+    assert im.kv.bytes_per_token() == pytest.approx(total)
+
+
+def test_int8_kv_per_token_price_counts_scale_planes():
+    im8 = make_im(max_tokens=8, max_requests=2, max_seq=32,
+                  use_pallas=True, kv_dtype="int8")
+    per8 = im8.kv.bytes_per_token()
+    assert per8 == kv_bytes_per_token(im8)
+    # int8 k/v (1B) + f32 scales must price BELOW bf16 k/v (2B): that
+    # byte gap is why int8 admits more under the same budget
+    names = {n for bufs in im8.state.values() for n in bufs
+             if n in KV_BUFFER_NAMES}
+    assert {"k", "v", "k_scale", "v_scale"} <= names
+    im_bf16 = make_im(max_tokens=8, max_requests=2, max_seq=32,
+                      use_pallas=True)
+    assert per8 < im_bf16.kv.bytes_per_token()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: memory layer on vs off (tokens AND caches)
+# ---------------------------------------------------------------------------
+def test_step_bit_identical_with_memory_layer():
+    from flexflow_tpu.serve.batch_config import BatchConfig
+
+    im = make_im(max_seq=64)
+    seq = np.zeros(im.max_requests, np.int32)
+    seq[0] = 3
+    bc = BatchConfig.build([3, 5, 7], [0, 0, 0], [0, 1, 2], seq,
+                           max_tokens=im.max_tokens,
+                           max_requests=im.max_requests)
+    r0 = im.step(bc)
+    want_tok = np.asarray(r0.token_ids).copy()
+    want_lg = np.asarray(r0.logits_max).copy()
+    want_state = _states_snapshot(im.state)
+
+    im = make_im(max_seq=64)
+    tel = _vclock_tel()
+    im.publish_memory(tel)  # ledger recording must not touch the step
+    im.telemetry = tel
+    bc = BatchConfig.build([3, 5, 7], [0, 0, 0], [0, 1, 2], seq,
+                           max_tokens=im.max_tokens,
+                           max_requests=im.max_requests)
+    try:
+        r1 = im.step(bc)
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    np.testing.assert_array_equal(np.asarray(r1.token_ids), want_tok)
+    np.testing.assert_array_equal(np.asarray(r1.logits_max), want_lg)
+    _assert_states_equal(want_state, im.state)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_generate_bit_identical_and_attribution_complete(kv_dtype):
+    prompts = [[3, 5, 7, 9, 11], [2, 4], [13, 6, 1]]
+    kw = (dict(max_tokens=8, max_requests=2, max_seq=32, use_pallas=True,
+               kv_dtype="int8") if kv_dtype else dict(max_seq=64))
+    im = make_im(**kw)
+    im.telemetry = NULL_TELEMETRY
+    want = RequestManager(im, GenerationConfig(max_new_tokens=6)).generate(
+        prompts)
+    want_state = _states_snapshot(im.state)
+
+    im = make_im(**kw)
+    tel = _vclock_tel()
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=6),
+                        telemetry=tel)
+    try:
+        got = rm.generate(prompts)
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    assert got == want, "memory telemetry changed serve outputs"
+    _assert_states_equal(want_state, im.state)
+
+    # no binding leaks past its terminal outcome, and every completed
+    # request carries its peak-KV attribution
+    assert im.kv.attributed_rids() == []
+    per_tok = im.kv.bytes_per_token()
+    for rid, req in rm.requests.items():
+        assert req.kv_bytes >= req.seq_len * per_tok * 0.999, rid
+    # the byte side landed in telemetry: gauges + per-request histogram
+    snap = tel.metrics.snapshot()
+    assert snap["request_kv_bytes"]["count"] == 3
+    assert 0.0 <= snap["kv_occupancy_frac"] <= 1.0
+    assert snap["kv_live_bytes_hwm"] > 0
+    # publish_memory reconciled predicted vs allocated per component
+    rep = tel.memory.report()
+    [(plan, fields)] = rep["plans"].items()
+    assert plan == "tp1_pp1_m1"
+    assert fields["kv_gb"]["predicted"] > 0
+    assert fields["kv_gb"]["measured"] > 0
+    # the ONLY allocated-vs-predicted KV gap at these shapes is the
+    # 128-lane seq pad (every KV plane scales linearly in seq) — the
+    # ledger surfaces it as an exact, explainable ratio
+    assert fields["kv_gb"]["ratio"] == pytest.approx(
+        128 / im.max_seq_len, rel=1e-3)
+    assert fields["weights_gb"]["ratio"] == pytest.approx(1.0, rel=1e-3)
+    assert rep["live"]["hwm_tokens"] > 0
+
+
+def test_arrivals_bit_identical_and_records_carry_kv_bytes():
+    rng = np.random.RandomState(7)
+    arrivals = poisson_arrivals(rng, 5, rate_per_s=30.0,
+                                vocab=TINY.vocab_size, max_new=4)
+    im = make_im(max_seq=64, max_requests=2)
+    im.telemetry = NULL_TELEMETRY
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4))
+    recs0 = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    want = [recs0[rid]["tokens"] for rid in sorted(recs0)]
+
+    im = make_im(max_seq=64, max_requests=2)
+    clk = VirtualClock()
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4),
+                        telemetry=Telemetry(clock=clk))
+    try:
+        recs1 = rm.serve_with_arrivals(arrivals, clock=clk)
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    assert [recs1[rid]["tokens"] for rid in sorted(recs1)] == want
+    assert im.kv.attributed_rids() == []
+    for rec in recs1.values():
+        assert rec["kv_bytes"] > 0  # every request here reached a slot
+
+
+def test_pp2_bit_identical_with_memory_layer():
+    from test_pp_serve import make_pp_im
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6]]
+    pim = make_pp_im({"pp": 2})
+    pim.telemetry = NULL_TELEMETRY
+    want = RequestManager(pim, GenerationConfig(max_new_tokens=5)).generate(
+        prompts)
+    want_state = _states_snapshot(pim.state)
+
+    pim = make_pp_im({"pp": 2})
+    tel = _vclock_tel()
+    rm = RequestManager(pim, GenerationConfig(max_new_tokens=5),
+                        telemetry=tel)
+    try:
+        got = rm.generate(prompts)
+    finally:
+        pim.telemetry = NULL_TELEMETRY
+    assert got == want
+    _assert_states_equal(want_state, pim.state)
+
+    # per-stage ownership composed under one deployment-level front
+    assert isinstance(pim.kv, KVAllocator)
+    assert len(pim.kv.stages) == 2
+    for stage, skv in zip(pim.stages, pim.kv.stages):
+        assert stage.state is skv.state
+    assert pim.kv.attributed_rids() == []
+    # the per-token price sums across stages and matches resilience's
+    assert kv_bytes_per_token(pim) == pim.kv.bytes_per_token()
+    assert pim.kv.bytes_per_token() == pytest.approx(
+        sum(s.bytes_per_token() for s in pim.kv.stages))
+    # the ledger recorded the pp plan under the serve-search key
+    assert "tp1_pp2" in next(iter(tel.memory.report()["plans"]))
+
+
+# ---------------------------------------------------------------------------
+# release-on-terminal: no outcome leaks attribution
+# ---------------------------------------------------------------------------
+def test_spec_serving_observes_live_kv_and_releases():
+    # the spec macro-step loop syncs the allocator like the incremental
+    # and arrival loops: live occupancy is observed while serving and all
+    # attribution releases at the end — with outputs still bit-identical
+    # to the telemetry-free run (tests/test_spec_infer pins spec-vs-incr)
+    from flexflow_tpu.serve import SpecInferManager
+
+    from test_spec_infer import TINY_SSM
+
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=TINY_SSM, topk=2, seed=123)
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    llm.telemetry = NULL_TELEMETRY
+    want = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                            width=2, depth=3).generate(prompts)
+    llm.reset()
+    ssm.reset()
+    tel = _vclock_tel()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                          width=2, depth=3, telemetry=tel)
+    try:
+        got = sm.generate(prompts)
+    finally:
+        llm.telemetry = NULL_TELEMETRY
+    assert got == want
+    snap = tel.metrics.snapshot()
+    assert snap["kv_live_bytes_hwm"] > 0, "spec loop never observed KV"
+    assert llm.kv.attributed_rids() == []
+    # the draft model is a co-resident deployment: its allocator joins
+    # the attribution protocol (no leak on completion), its live KV is
+    # counted in the combined gauges (capacity = target + draft), and
+    # its allocation lands in the ledger under its own _draft plan key
+    assert ssm.kv.attributed_rids() == []
+    assert ssm.kv.hwm_tokens > 0, "draft KV never observed"
+    # the final sync runs with every request drained, so the headroom
+    # gauge reads the full COMBINED capacity — proving the published
+    # view sums target + draft rather than the target alone
+    combined_cap = llm.kv.capacity_bytes() + ssm.kv.capacity_bytes()
+    assert snap["kv_headroom_bytes"] == combined_cap
+    assert combined_cap > llm.kv.capacity_bytes()
+    mem = tel.memory.report()
+    draft_keys = [k for k in mem["plans"] if k.endswith("_draft")]
+    assert draft_keys, f"no draft plan in memory ledger: {list(mem['plans'])}"
+    assert mem["plans"][draft_keys[0]]["static_gb"]["error_frac"] is not None
+
+
+def test_rejected_requests_hold_no_attribution():
+    im = make_im(max_seq=64)
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4),
+                        telemetry=_vclock_tel(),
+                        resilience=ResilienceConfig(max_pending=2))
+    try:
+        rm.generate([[3, 5, 7], [2, 4, 6], [11, 13], [9, 8, 1]])
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    assert im.kv.attributed_rids() == []
+    for rid, req in rm.requests.items():
+        if req.status is RequestStatus.REJECTED:
+            assert req.kv_bytes == 0.0, "a rejected request held no cache"
+        else:
+            assert req.kv_bytes > 0.0
+
+
+def test_cancel_releases_attribution_mid_serve():
+    im = make_im(max_seq=64)
+    rm = quiet(RequestManager(im, GenerationConfig(max_new_tokens=12),
+                              telemetry=_vclock_tel()))
+    rm.scan_chunk = 2
+    arrivals = [(0.0, [3, 11, 25, 40, 7], 12), (0.0, [2, 4, 6, 8], 12)]
+    clock = TriggerClock(
+        ready=lambda: 1 in rm.requests
+        and 2 <= len(rm.requests[1].generated) < 11,
+        fn=lambda: rm.cancel(1))
+    try:
+        records = rm.serve_with_arrivals(arrivals, clock=clock)
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    assert clock.fired and records[1]["outcome"] == "cancelled"
+    assert im.kv.attributed_rids() == []
+    # the cancelled request DID hold cache: its peak rides the record
+    assert records[1]["kv_bytes"] > 0
+
+
+def test_timeout_in_queue_and_timeout_mid_decode_release():
+    im = make_im(max_seq=64)
+    rm = quiet(RequestManager(im, GenerationConfig(max_new_tokens=8),
+                              telemetry=_vclock_tel()))
+    arrivals = [
+        (0.0, [3, 11, 25, 40, 7], 8),
+        (0.0, [2, 4, 6, 8], 8),
+        (0.0, [9, 1, 5], 8, {"ttl_s": 0.05}),  # expires while queued
+    ]
+    try:
+        records = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    assert records[2]["outcome"] == "timeout"
+    assert records[2]["kv_bytes"] == 0.0, "never slotted -> no attribution"
+    assert im.kv.attributed_rids() == []
+
+
+def test_preempt_readmit_attributes_peak_and_releases():
+    from test_resilience import _serve_with_midway_preempt
+
+    im = make_im(max_seq=64)
+    gen = GenerationConfig(max_new_tokens=10)
+    im.telemetry = NULL_TELEMETRY
+    rm, records = _serve_with_midway_preempt(im, gen,
+                                             [[3, 11, 25, 40, 7],
+                                              [2, 4, 6, 8]],
+                                             preempt_rid=0)
+    assert rm.requests[0].preemptions == 1
+    assert im.kv.attributed_rids() == []
+    # attribution is the PEAK across bindings: at least the final depth
+    per_tok = im.kv.bytes_per_token()
+    assert records[0]["kv_bytes"] >= rm.requests[0].seq_len * per_tok * 0.999
+
+
+def test_failed_requests_release_attribution():
+    im = make_im(max_seq=64)
+    inj = FaultInjector(seed=0, p=1.0)  # every dispatch faults, forever
+    rm = quiet(RequestManager(
+        im, GenerationConfig(max_new_tokens=6), telemetry=_vclock_tel(),
+        fault_injector=inj,
+        resilience=ResilienceConfig(retry=RetryPolicy(max_retries=1),
+                                    on_dispatch_failure="fail")))
+    try:
+        got = rm.generate([[3, 5, 7], [2, 4]])
+    finally:
+        im.telemetry = NULL_TELEMETRY
+        im.fault_injector = None
+    assert got == [[], []]
+    assert all(r.status is RequestStatus.FAILED
+               for r in rm.requests.values())
+    assert im.kv.attributed_rids() == []
+
+
+# ---------------------------------------------------------------------------
+# plan health: the OOM-risk check
+# ---------------------------------------------------------------------------
+def test_memory_pressure_breach_is_projected_and_edge_triggered():
+    im = make_im(max_seq=32, max_requests=2)  # capacity: 64 positions
+    tel = _vclock_tel()
+    kv = im.kv
+    kv.reset_attribution()
+    # live profile says finished requests emit ~40 tokens each
+    for i in range(4):
+        tel.request_finished(f"r{i:05d}", n_tokens=40)
+    monitor = PlanHealthMonitor(
+        tel, {"plan_key": "tp1_pp1_m1"},
+        config=PlanHealthConfig(min_requests=10**6, drift_min_samples=10**6),
+        kv_allocator=kv)
+
+    # one live request at depth 20: projected 20 + 40 = 60 of 64 -> ok
+    kv.bind(0)
+    kv.observe({0: 20}, tel)
+    rep = monitor.check()
+    assert rep["healthy"]
+    assert rep["memory"]["projected_frac"] < 1.0
+
+    # two live requests: projected 40 + 2*40 = 120 of 64 -> breach
+    kv.bind(1)
+    kv.observe({0: 20, 1: 20}, tel)
+    rep = monitor.check()
+    assert "memory_pressure" in rep["reasons"]
+    assert rep["memory"]["projected_bytes"] > rep["memory"]["capacity_bytes"]
+    assert tel.metrics.counter("memory_pressure_events").value == 1
+    # edge-triggered: a persisting breach does not re-emit the instant
+    monitor.check()
+    assert tel.metrics.counter("memory_pressure_events").value == 1
+    # pressure clears, then a NEW excursion re-emits
+    kv.release(1)
+    kv.observe({0: 1}, tel)
+    assert monitor.check()["healthy"]
+    kv.bind(1)
+    kv.observe({0: 20, 1: 20}, tel)
+    monitor.check()
+    assert tel.metrics.counter("memory_pressure_events").value == 2
+    # the breach event validates against the exported schema
+    names = [e["name"] for e in tel.trace.trace_events()
+             if e.get("ph") == "i" and e.get("cat") == "plan"]
+    assert names.count("memory_pressure") == 2
+
+
+def test_request_manager_wires_allocator_into_plan_health():
+    im = make_im(max_seq=64)
+    tel = _vclock_tel()
+    monitor = PlanHealthMonitor(
+        tel, {"plan_key": "tp1_pp1_m1"},
+        config=PlanHealthConfig(min_requests=10**6, drift_min_samples=10**6))
+    assert monitor.kv_allocator is None
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4),
+                        telemetry=tel, plan_health=monitor)
+    try:
+        rm.generate([[3, 5, 7]])
+    finally:
+        im.telemetry = NULL_TELEMETRY
+    assert monitor.kv_allocator is im.kv
+    # the serve loop's forced final health check priced the byte side
+    assert monitor.checks > 0
+
+
+def test_kv_budget_gate_fails_safe_when_caches_freed():
+    # an explicit BYTE cap must never silently degrade to token-slot
+    # units: freeing the caches after construction (im.state = None, the
+    # bench's between-phases HBM release) makes the gate REJECT instead
+    # of comparing tokens against a byte budget and admitting everything
+    im = make_im(max_seq=32, max_requests=2)
+    rm = RequestManager(
+        im, GenerationConfig(max_new_tokens=8),
+        resilience=ResilienceConfig(kv_gate=True, kv_budget_bytes=10**9))
+    r1 = rm.register_new_request([3, 5, 7])
+    assert rm.requests[r1].status is RequestStatus.PENDING
+    im.state = None
+    r2 = rm.register_new_request([2, 4, 6])
+    assert rm.requests[r2].status is RequestStatus.REJECTED
+    im.kv.allocate()  # restore for the cached-im pool
+
+
+def test_spec_kv_snapshot_and_plan_health_cover_both_deployments():
+    # the manager-level view (llm.memory_report()'s source) and the
+    # plan-health OOM projection must account the draft model's cache,
+    # not just the target's
+    from flexflow_tpu.serve import SpecInferManager
+
+    from test_spec_infer import TINY_SSM
+
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=TINY_SSM, topk=2, seed=123)
+    tel = _vclock_tel()
+    monitor = PlanHealthMonitor(
+        tel, {"plan_key": "tp1_pp1_m1"},
+        config=PlanHealthConfig(min_requests=10**6, drift_min_samples=10**6))
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=4),
+                          width=2, depth=3, telemetry=tel,
+                          plan_health=monitor)
+    try:
+        # auto-wiring widened from the target allocator to BOTH caches
+        assert monitor.kv_allocator == [llm.kv, ssm.kv]
+        snap = sm.kv_snapshot()
+        assert snap["capacity_bytes"] == (llm.kv.capacity_bytes()
+                                          + ssm.kv.capacity_bytes())
+        # a live request on both caches: the OOM check prices each at its
+        # own bytes/token and sums
+        llm.kv.bind(0)
+        llm.kv.observe({0: 16}, tel)
+        ssm.kv.bind(0)
+        ssm.kv.observe({0: 12}, None)
+        rep = monitor.check()
+        expect = (16 * llm.kv.bytes_per_token()
+                  + 12 * ssm.kv.bytes_per_token())
+        assert rep["memory"]["live_bytes"] == pytest.approx(expect, rel=1e-6)
+        assert rep["memory"]["capacity_bytes"] == pytest.approx(
+            llm.kv.capacity_bytes() + ssm.kv.capacity_bytes(), rel=1e-6)
+    finally:
+        llm.telemetry = NULL_TELEMETRY
+        llm.kv.reset_attribution()
+        ssm.kv.reset_attribution()

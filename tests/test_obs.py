@@ -303,3 +303,40 @@ def test_pp2_trace_stage_spans_and_calibration(tmp_path):
     assert summary["requests"] == 2 and summary["completed"] == 2
     assert "tp1_pp2_m2" in summary["prediction_error"]
     assert any(k.startswith("stage") for k in summary["span_ms_by_track"])
+
+
+# ---------------------------------------------------------------------------
+# schema consistency: no emitter can bypass trace_report --check (ISSUE 8)
+# ---------------------------------------------------------------------------
+def test_every_emitted_typed_event_is_in_event_schema():
+    """Grep-based CI gate: every typed instant (cat request/dispatch/plan)
+    emitted anywhere in flexflow_tpu/ (and the bench emitters) must appear
+    in ``telemetry.EVENT_SCHEMA`` — new instrumentation that skips the
+    schema would silently dodge ``trace_report.py --check``."""
+    import os
+    import re
+
+    from flexflow_tpu.obs.telemetry import EVENT_SCHEMA
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # name + the cat right after it, positional or keyword, across lines
+    pat = re.compile(
+        r"""\.instant\(\s*["'](\w+)["']\s*,\s*(?:cat\s*=\s*)?["'](\w+)["']""",
+        re.S)
+    sources = [os.path.join(repo, "bench.py")]
+    for root, _dirs, files in os.walk(os.path.join(repo, "flexflow_tpu")):
+        sources += [os.path.join(root, f) for f in files
+                    if f.endswith(".py")]
+    emitted = set()
+    for path in sources:
+        with open(path) as f:
+            for name, cat in pat.findall(f.read()):
+                if cat in ("request", "dispatch", "plan"):
+                    emitted.add((name, cat))
+    assert emitted, "grep found no typed emitters — the pattern broke"
+    unknown = {(n, c) for n, c in emitted
+               if EVENT_SCHEMA.get(n) is None or EVENT_SCHEMA[n][0] != c}
+    assert not unknown, (
+        f"typed events emitted but missing from EVENT_SCHEMA: {unknown}")
+    # and the vocabulary this PR added is actually reachable
+    assert ("memory_pressure", "plan") in emitted

@@ -130,11 +130,61 @@ def test_dry_run_workload_drift_recommends_replan(dryrun):
     assert reported["workload"]["prompt_len"]["mean"] > 256
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 8: the memory ledger (predicted vs allocated vs live), hermetically
+# on the virtual clock, round-tripped through trace_report
+# ---------------------------------------------------------------------------
+def test_dry_run_memory_ledger_reconciles_and_roundtrips(dryrun):
+    _, doc = dryrun
+    ml = doc["observability"]["memory_ledger"]
+
+    # fill -> preempt -> release left no attribution behind
+    assert ml["leak_free"]
+    assert ml["preempt_released_bytes"] > 0
+    assert ml["kv_bytes_per_token"] > 0
+
+    # predicted vs allocated reconcile per component within tolerance
+    # (max_seq = the 128-lane pad quantum, so the model error is tiny)
+    [(plan, fields)] = ml["ledger"]["plans"].items()
+    for comp in ("weights_gb", "kv_gb", "static_gb"):
+        assert fields[comp]["predicted"] > 0
+        assert fields[comp]["measured"] > 0
+        assert abs(fields[comp]["error_frac"]) <= 0.02, comp
+    # the transient-inclusive total stays one-sided by design: nothing
+    # "allocates" an activation, so reconciling it would book the
+    # transient share as model error
+    assert fields["total_gb"]["measured"] is None
+    comps = ml["ledger"]["components"]
+    assert 0.98 <= comps["kv_gb"]["suggested_scale"] <= 1.02
+
+    # live watermarks: the fill phase peak survived the releases
+    live = ml["ledger"]["live"]
+    assert live["hwm_tokens"] > 0
+    assert 0 < live["hwm_frac"] < 1
+    assert ml["summary"]["live"] == live
+    assert ml["summary"]["occupancy_p95"] >= ml["summary"]["occupancy_p50"]
+    g = ml["summary"]["gauges"]
+    assert g["kv_live_bytes_hwm"] == live["hwm_bytes"]
+    assert 0 < g["kv_fragmentation_frac"] < 1
+    assert ml["summary"]["request_kv_bytes"]["count"] == 2
+
+    # stamp-ready device fields for the r6-r9 hbm_frac close-out
+    assert set(ml["device_fields"]) == {"hbm_frac", "hbm_capacity_gb",
+                                        "kv_hwm_gb"}
+
+    # the CLI reproduces the memory section from the JSONL alone
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         ml["paths"]["jsonl"]]))
+    assert reported["memory"] == ml["summary"]
+
+
 def test_check_mode_validates_dry_run_schema(dryrun):
     out, doc = dryrun
     script = os.path.join(REPO, "scripts", "trace_report.py")
     for jsonl in (doc["observability"]["paths"]["jsonl"],
-                  doc["observability"]["feedback_loop"]["paths"]["jsonl"]):
+                  doc["observability"]["feedback_loop"]["paths"]["jsonl"],
+                  doc["observability"]["memory_ledger"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
