@@ -40,6 +40,12 @@ def main():
                     help="KV-cache storage dtype (int8: quantize-on-write "
                          "caches with dequant fused into the Pallas "
                          "attention kernels)")
+    ap.add_argument("--kv-page-size", type=int, default=0,
+                    help="enable the paged KV cache with copy-on-write "
+                         "prefix sharing (serve/kv_paged.py): pages of "
+                         "this many tokens, block-table indirection in "
+                         "the kernels; must divide max-seq and its "
+                         "128-lane pad; 0 = slot-contiguous")
     ap.add_argument("--profile", action="store_true",
                     help="capture an XProf (jax.profiler) trace of the "
                          "serve run in a fresh timestamped dir under "
@@ -99,6 +105,7 @@ def main():
             n_micro=args.microbatches or None,
             outputs=logits,
             kv_dtype=args.kv_dtype,
+            kv_page_size=args.kv_page_size or None,
         )
         gb = [round(b / 1e9, 3) for b in im.stage_memory_bytes()]
         print(f"pp{args.pp} x tp{args.tp}: per-stage plan GB {gb}")
@@ -113,6 +120,7 @@ def main():
             max_seq_len=args.max_seq,
             outputs=logits,
             kv_dtype=args.kv_dtype,
+            kv_page_size=args.kv_page_size or None,
         )
     im.init_operators_inference(rng=jax.random.PRNGKey(0))
     from flexflow_tpu.obs import Telemetry

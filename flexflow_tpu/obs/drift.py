@@ -99,11 +99,18 @@ class WorkloadProfile:
     """
 
     def __init__(self, window: int = 512):
+        from collections import deque
+
         self.window = window
         self._dims: Dict[str, _Window] = {
             d: _Window(_DIM_EDGES[d], window) for d in PROFILE_DIMS
         }
         self._last_arrival: Optional[float] = None
+        # paged-KV prefix sharing: the windowed fraction of binds that hit
+        # the prefix cache (serve/kv_paged.py).  Not a PSI drift dimension
+        # — it feeds the serve search's sharing discount (the fraction of
+        # offered prefill work the page pool absorbs).
+        self._prefix_hits = deque(maxlen=window)
 
     # ---- observation hooks (fed by Telemetry.request_* et al.) --------
     def observe_enqueue(self, prompt_len: int,
@@ -125,6 +132,11 @@ class WorkloadProfile:
 
     def observe_spec_acceptance(self, frac: float) -> None:
         self._dims["spec_acceptance"].observe(frac)
+
+    def observe_prefix(self, hit: bool) -> None:
+        """One paged-KV bind's prefix-cache outcome (Telemetry
+        .prefix_cache_hit/miss feed this)."""
+        self._prefix_hits.append(bool(hit))
 
     # ---- views ---------------------------------------------------------
     def snapshot(self) -> Dict:
@@ -151,6 +163,11 @@ class WorkloadProfile:
                                    if mean_iat and mean_iat > 0 else 0.0),
             "mean_occupancy": occ if occ is not None else 1.0,
             "mean_spec_acceptance": acc if acc is not None else 0.0,
+            # fraction of recent binds whose prompt prefix was already
+            # cached (0.0 cold / unpaged — neutral: no sharing discount)
+            "shared_prefix_frac": (sum(self._prefix_hits)
+                                   / len(self._prefix_hits)
+                                   if self._prefix_hits else 0.0),
             "n_requests": len(d["prompt_len"]._xs),
         }
 

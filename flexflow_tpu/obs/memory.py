@@ -47,6 +47,22 @@ MEMORY_GAUGE_KEYS = {
 }
 MEMORY_GAUGES = tuple(MEMORY_GAUGE_KEYS)
 
+# the paged-allocator extension (serve/kv_paged.py): page-pool occupancy
+# and sharing/refcount gauges, published by Telemetry.kv_usage only when
+# the snapshot carries the page vocabulary (a slot-contiguous allocator
+# never emits zeros for pools it doesn't have).  Same one-table contract
+# as MEMORY_GAUGE_KEYS: kv_usage EMITS by iterating it, the report READS
+# its keys.
+PAGED_GAUGE_KEYS = {
+    "kv_pages_live": "pages_live",
+    "kv_pages_shared": "pages_shared",
+    "kv_pages_free": "pages_free",
+    "kv_pages_indexed": "pages_indexed",
+    "kv_page_cow_copies": "cow_copies",
+    "kv_pages_evicted": "pages_evicted",
+}
+PAGED_GAUGES = tuple(PAGED_GAUGE_KEYS)
+
 # the occupancy distribution (p50/p95 in the report) rides a histogram
 # under this registry name
 KV_OCCUPANCY_HIST = "kv_occupancy"

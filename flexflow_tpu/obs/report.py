@@ -35,6 +35,9 @@ _TERMINAL_EVENTS = {
 _PREEMPT = "request_preempt"
 _RETRY = "dispatch_retry"
 _FAULT = "dispatch_fault"
+# paged-KV prefix sharing (serve/kv_paged.py)
+_PREFIX_HIT = "prefix_hit"
+_PREFIX_MISS = "prefix_miss"
 # observe->calibrate->re-plan loop events (obs/drift.py, obs/plan_health.py)
 _DRIFT = "drift_detected"
 _REPLAN = "replan_recommended"
@@ -61,6 +64,7 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     track_names: Dict[int, str] = {}
     outcomes: Dict[str, int] = {}
     preemptions = retries = faults = 0
+    prefix_hits = prefix_misses = 0
     drift_events: List[Dict] = []
     replans: List[Dict] = []
     mem_pressure: List[Dict] = []
@@ -80,6 +84,12 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _FAULT:
             faults += 1
+            continue
+        if name == _PREFIX_HIT:
+            prefix_hits += 1
+            continue
+        if name == _PREFIX_MISS:
+            prefix_misses += 1
             continue
         if name == _DRIFT:
             drift_events.append(ev.get("args", {}))
@@ -147,6 +157,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         "preemptions": preemptions,
         "dispatch_retries": retries,
         "dispatch_faults": faults,
+        # paged-KV prefix sharing: binds that reused registered pages
+        "prefix_hits": prefix_hits,
+        "prefix_misses": prefix_misses,
         # plan feedback loop: drift excursions + replan recommendations
         "drift_detected": drift_events,
         "replan_recommended": replans,
@@ -234,7 +247,7 @@ def memory_section(memory: Dict, metrics: Dict) -> Dict:
     vocabulary.  Shared by ``bench.py --dry-run``'s ``memory_ledger``
     section and the trace-report CLI (one accounting, two consumers).
     """
-    from .memory import KV_OCCUPANCY_HIST, MEMORY_GAUGES
+    from .memory import KV_OCCUPANCY_HIST, MEMORY_GAUGES, PAGED_GAUGES
 
     occ = metrics.get(KV_OCCUPANCY_HIST) or {}
     section: Dict = {
@@ -244,6 +257,16 @@ def memory_section(memory: Dict, metrics: Dict) -> Dict:
         "gauges": {g: metrics[g] for g in MEMORY_GAUGES if g in metrics},
         "request_kv_bytes": metrics.get("request_kv_bytes"),
     }
+    # paged-KV view (serve/kv_paged.py): page-pool gauges + the prefix
+    # cache's hit/reuse counters — present only when a paged allocator
+    # published them
+    paged = {g: metrics[g] for g in PAGED_GAUGES if g in metrics}
+    if paged:
+        section["paged"] = paged
+        section["prefix_cache"] = {
+            k: metrics[k] for k in ("prefix_hits", "prefix_misses",
+                                    "prefix_tokens_reused")
+            if k in metrics}
     alloc_err: Dict[str, Dict] = {}
     for plan, fields in memory.get("plans", {}).items():
         alloc_err[plan] = {

@@ -77,6 +77,10 @@ EVENT_SCHEMA = {
     # OOM-risk breach PlanHealthMonitor emits when projected KV growth
     # from the live workload profile eats the allocator's headroom
     "memory_pressure": ("plan", ("projected_bytes", "capacity_bytes")),
+    # paged KV prefix sharing (serve/kv_paged.py): did a request's bind
+    # reuse registered prefix pages (skipping that much prefill) or not
+    "prefix_hit": ("request", ("trace_id",)),
+    "prefix_miss": ("request", ("trace_id",)),
 }
 
 
@@ -212,6 +216,25 @@ class Telemetry:
         return self.trace.instant("dispatch_fault", "dispatch", "dispatch",
                                   site=site, detail=detail)
 
+    # ---- paged KV prefix sharing (serve/kv_paged.py) ------------------
+    def prefix_cache_hit(self, trace_id: str, tokens_reused: int = 0,
+                         pages: int = 0) -> float:
+        """A bind reused ``tokens_reused`` positions of registered prefix
+        pages — that much prefill is skipped (TTFT collapses to the
+        unshared suffix)."""
+        self.metrics.counter("prefix_hits").inc()
+        self.metrics.counter("prefix_tokens_reused").inc(tokens_reused)
+        self.workload.observe_prefix(True)
+        return self.trace.instant("prefix_hit", "request", "requests",
+                                  trace_id=trace_id,
+                                  tokens_reused=tokens_reused, pages=pages)
+
+    def prefix_cache_miss(self, trace_id: str) -> float:
+        self.metrics.counter("prefix_misses").inc()
+        self.workload.observe_prefix(False)
+        return self.trace.instant("prefix_miss", "request", "requests",
+                                  trace_id=trace_id)
+
     def batch_composition(self, decode_tokens: int, prefill_tokens: int,
                           active_requests: int, max_requests: int,
                           kv_tokens: int, kv_capacity: int) -> None:
@@ -259,6 +282,11 @@ class Telemetry:
         occ = snap.get("occupancy_frac", 0.0)
         for gauge, key in MEMORY_GAUGE_KEYS.items():
             m.gauge(gauge).set(snap.get(key, 0.0))
+        if "pages_live" in snap:  # paged allocator: page-pool vocabulary
+            from .memory import PAGED_GAUGE_KEYS
+
+            for gauge, key in PAGED_GAUGE_KEYS.items():
+                m.gauge(gauge).set(snap.get(key, 0.0))
         m.histogram(KV_OCCUPANCY_HIST).observe(occ)
         self.trace.counter("kv_occupancy_frac", occ)
         self.memory.observe_live(snap.get("live_bytes", 0.0),
@@ -382,6 +410,12 @@ class NullTelemetry:
         return 0.0
 
     def request_failed(self, *a, **k):
+        return 0.0
+
+    def prefix_cache_hit(self, *a, **k):
+        return 0.0
+
+    def prefix_cache_miss(self, *a, **k):
         return 0.0
 
     def dispatch_retry(self, *a, **k):

@@ -30,6 +30,17 @@ Design (v2 — measured on a real v5e chip):
   by the key's scale after the Q·K dot, attention weights multiply by the
   value's scale before the P·V dot — so int8 KV never materializes as bf16
   in HBM; only int8 bytes (+ 4-byte scales per 2*D-byte vector pair) move.
+* **paged KV (block-table indirection)**: with ``page_table`` (i32
+  ``[rows, pages_per_row]``, scalar-prefetched) and a static ``page_size``,
+  the cache's ``rows x seq`` space is a pool of fixed-size pages and a
+  token's LOGICAL block ``j`` resolves to a physical page through its cache
+  row's table entry — the vLLM/PagedAttention design
+  (Kwon et al., SOSP'23) on the existing grid.  The kernel body is
+  untouched: positions/masks stay logical, only the K/V (+ scale) index
+  maps gather the page base per kv-chunk, so ``block_s`` is capped to
+  divide ``page_size`` and a seq-block never straddles a page boundary.
+  The causal DMA clamp composes: a clamped future block re-maps to the
+  frontier's PHYSICAL page, whose copy Pallas then skips as before.
 
 Under tensor parallelism the caller (serve/ops.py) wraps these kernels in a
 ``shard_map`` over the kv-head axis — the cache's head dim is the shard dim,
@@ -74,6 +85,16 @@ def _fit_block_s(block_s, s_len, num_kv, d, itemsize, kv_quant, budget):
     return block_s
 
 
+def _page_coords(pt, row, jc, block_s, page_size, ppr):
+    """Physical (row, seq-block) coordinates of LOGICAL seq-block ``jc`` of
+    cache row ``row`` through the page table — the one translation all
+    three kernels' K/V index maps share.  ``block_s`` divides ``page_size``
+    (the callers gcd-cap it), so a block never straddles two pages."""
+    bpp = page_size // block_s
+    pid = pt[row, jc // bpp]
+    return pid // ppr, (pid % ppr) * bpp + jc % bpp
+
+
 def _scale_plumbing(kv_map, num_kv, block_s, k_scale, v_scale):
     """BlockSpecs + operands for the int8-KV dequant scales (one shared
     construction for all three kernels).
@@ -99,17 +120,20 @@ def _scale_plumbing(kv_map, num_kv, block_s, k_scale, v_scale):
 def _decode_kernel(
     rows_ref,       # scalar prefetch: i32[T] cache row per token
     pos_ref,        # scalar prefetch: i32[T] absolute position per token
-    q_ref,          # [1, KV, gq, D] this token's queries (kv-major)
-    k_ref,          # [1, KV, Bs, D] cache K block (row rows[t], block s)
-    v_ref,          # [1, KV, Bs, D]
-    *rest,          # [ks_ref, vs_ref,] slopes_ref, o_ref, m/l/acc scratch
+    *refs,          # [pt_ref (paged),] q_ref, k_ref, v_ref,
+                    # [ks_ref, vs_ref,] slopes_ref, o_ref, m/l/acc scratch
     block_s: int,
     num_kv: int,
     gq: int,
     scale: float,
     use_alibi: bool,
     kv_quant: bool,
+    paged: bool = False,
 ):
+    if paged:
+        # the page-table prefetch ref is consumed by the index maps only
+        refs = refs[1:]
+    q_ref, k_ref, v_ref, *rest = refs
     if kv_quant:
         # ks/vs: [1, KV, Bs] f32 per-position dequant scales, same block
         # index map as K/V
@@ -179,7 +203,8 @@ def _decode_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_s", "use_alibi", "interpret"),
+    static_argnames=("scale", "block_s", "use_alibi", "interpret",
+                     "page_size"),
 )
 def decode_attention(
     q: jax.Array,        # [T, QH, D] (RoPE already applied)
@@ -194,34 +219,60 @@ def decode_attention(
     interpret: bool = False,
     k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8-KV dequant
     v_scale: Optional[jax.Array] = None,  # scales (None = fp cache)
+    page_table: Optional[jax.Array] = None,  # i32[R+1, S//page_size] paged KV
+    page_size: int = 0,                      # static; 0 = slot-contiguous
 ) -> jax.Array:
     t, qh, d = q.shape
     _, num_kv, s_len, _ = k_cache.shape
     gq = qh // num_kv
     kv_quant = k_scale is not None
+    paged = page_table is not None
     # cap the block so K+V (+ scale) double-buffered blocks fit the budget
     block_s = _fit_block_s(block_s, s_len, num_kv, d,
                            jnp.dtype(k_cache.dtype).itemsize, kv_quant,
                            _VMEM_BUDGET)
+    if paged:
+        # a seq-block must sit inside ONE page (page_size divides the padded
+        # seq length by the allocator's construction-time assert, so the
+        # gcd keeps a dividing block)
+        block_s = math.gcd(block_s, page_size)
     n_blocks = s_len // block_s
     qr = q.reshape(t, num_kv, gq, d)
     if slopes is None:
         slopes = jnp.zeros((qh,), jnp.float32)
     slopes = slopes.astype(jnp.float32).reshape(num_kv, gq)
 
-    def kv_map(i, j, rows, pos):
-        # clamp to the causal frontier: future blocks re-map to the frontier
-        # block, whose copy Pallas then skips (same index as previous step)
-        return (rows[i], 0, jnp.minimum(j, pos[i] // block_s), 0)
+    if paged:
+        ppr = s_len // page_size
+
+        def kv_map(i, j, rows, pos, pt):
+            # causal clamp in LOGICAL block space, then the page table
+            # resolves the physical page (clamped blocks re-map to the
+            # frontier's physical block, whose copy Pallas skips)
+            jc = jnp.minimum(j, pos[i] // block_s)
+            prow, pblk = _page_coords(pt, rows[i], jc, block_s, page_size,
+                                      ppr)
+            return (prow, 0, pblk, 0)
+
+        prefetch = (rows.astype(jnp.int32), positions.astype(jnp.int32),
+                    page_table.astype(jnp.int32))
+    else:
+        def kv_map(i, j, rows, pos):
+            # clamp to the causal frontier: future blocks re-map to the
+            # frontier block, whose copy Pallas then skips (same index as
+            # previous step)
+            return (rows[i], 0, jnp.minimum(j, pos[i] // block_s), 0)
+
+        prefetch = (rows.astype(jnp.int32), positions.astype(jnp.int32))
 
     scale_specs, scale_args = _scale_plumbing(
         kv_map, num_kv, block_s, k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(t, n_blocks),
         in_specs=[
             pl.BlockSpec(
-                (1, num_kv, gq, d), lambda i, j, rows, pos: (i, 0, 0, 0),
+                (1, num_kv, gq, d), lambda i, j, *_: (i, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -232,12 +283,12 @@ def decode_attention(
             ),
             *scale_specs,
             pl.BlockSpec(
-                (num_kv, gq), lambda i, j, rows, pos: (0, 0),
+                (num_kv, gq), lambda i, j, *_: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, num_kv, gq, d), lambda i, j, rows, pos: (i, 0, 0, 0),
+            (1, num_kv, gq, d), lambda i, j, *_: (i, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
@@ -250,14 +301,14 @@ def decode_attention(
         _decode_kernel,
         block_s=block_s, num_kv=num_kv, gq=gq,
         scale=float(scale), use_alibi=use_alibi, kv_quant=kv_quant,
+        paged=paged,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, num_kv, gq, d), q.dtype),
         interpret=interpret,
-    )(rows.astype(jnp.int32), positions.astype(jnp.int32),
-      qr, k_cache, v_cache, *scale_args, slopes)
+    )(*prefetch, qr, k_cache, v_cache, *scale_args, slopes)
     return out.reshape(t, qh, d)
 
 
@@ -307,17 +358,20 @@ def _prefill_kernel(
     rows_ref,       # scalar prefetch: i32[G] cache row per tile
     pstart_ref,     # scalar prefetch: i32[G] first position in tile
     fmax_ref,       # scalar prefetch: i32[G] causal frontier (last position)
-    q_ref,          # [1, KC, M, D] tile queries, M = Bq*gq (b-major fold)
-    k_ref,          # [1, KC, Bs, D] cache K block (row rows[g], chunk kc,
-    v_ref,          # [1, KC, Bs, D]  seq block s)
-    *rest,          # [ks_ref, vs_ref,] o_ref, m/l/acc scratch
+    *refs,          # [pt_ref (paged),] q_ref ([1, KC, M, D] tile queries,
+                    # M = Bq*gq b-major fold), k_ref/v_ref ([1, KC, Bs, D]
+                    # cache blocks), [ks_ref, vs_ref,] o_ref, m/l/acc scratch
     block_s: int,
     num_kv: int,    # heads PER GRID STEP (= kv_chunk)
     gq: int,
     m_rows: int,
     scale: float,
     kv_quant: bool,
+    paged: bool = False,
 ):
+    if paged:
+        refs = refs[1:]  # page table: index-map-only prefetch operand
+    q_ref, k_ref, v_ref, *rest = refs
     if kv_quant:
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -384,20 +438,23 @@ def _prefill_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_s", "kv_chunk", "interpret")
+    jax.jit, static_argnames=("scale", "block_s", "kv_chunk", "interpret",
+                              "page_size")
 )
 def prefill_attention(
     q: jax.Array,        # [G, Bq, QH, D] tile queries (RoPE applied)
     k_cache: jax.Array,  # [R+1, KV, S, D] (this step's KV already written)
     v_cache: jax.Array,  # [R+1, KV, S, D]
     rows: jax.Array,     # i32[G] cache row per tile
-    pstart: jax.Array,   # i32[G] first token position per tile
+    pstart: jax.Array,   # i32[G] first token position per tile (LOGICAL)
     scale: float,
     block_s: int = 512,
     kv_chunk: Optional[int] = None,
     interpret: bool = False,
     k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8-KV dequant
     v_scale: Optional[jax.Array] = None,  # scales (None = fp cache)
+    page_table: Optional[jax.Array] = None,  # i32[R+1, S//page_size]
+    page_size: int = 0,                      # static; 0 = slot-contiguous
 ) -> jax.Array:
     """Q-tiled prefill attention (the prompt phase of the reference's IncMHA).
 
@@ -423,6 +480,7 @@ def prefill_attention(
     gq = qh // num_kv
     m_rows = bq * gq
     kv_quant = k_scale is not None
+    paged = page_table is not None
     plan_kc, plan_bs = _prefill_plan(
         num_kv, d, jnp.dtype(k_cache.dtype).itemsize, kv_quant, m_rows,
         block_s, s_len)
@@ -435,6 +493,8 @@ def prefill_attention(
         block_s = _fit_block_s(block_s, s_len, kv_chunk, d,
                                jnp.dtype(k_cache.dtype).itemsize, kv_quant,
                                _VMEM_BUDGET_PREFILL)
+    if paged:  # a seq-block must sit inside one page (see decode_attention)
+        block_s = math.gcd(block_s, page_size)
     n_kc = num_kv // kv_chunk
     n_blocks = s_len // block_s
     # fold tiles into the query-group dim, b-major: row = b*gq + g'
@@ -442,18 +502,32 @@ def prefill_attention(
          .reshape(g, num_kv, m_rows, d)
     fmax = jnp.clip(pstart + bq - 1, 0, s_len - 1)
 
-    def kv_map(i, kc, j, rows, pstart, fmax):
-        return (rows[i], kc, jnp.minimum(j, fmax[i] // block_s), 0)
+    if paged:
+        ppr = s_len // page_size
+
+        def kv_map(i, kc, j, rows, pstart, fmax, pt):
+            jc = jnp.minimum(j, fmax[i] // block_s)
+            prow, pblk = _page_coords(pt, rows[i], jc, block_s, page_size,
+                                      ppr)
+            return (prow, kc, pblk, 0)
+
+        prefetch = (rows.astype(jnp.int32), pstart.astype(jnp.int32), fmax,
+                    page_table.astype(jnp.int32))
+    else:
+        def kv_map(i, kc, j, rows, pstart, fmax):
+            return (rows[i], kc, jnp.minimum(j, fmax[i] // block_s), 0)
+
+        prefetch = (rows.astype(jnp.int32), pstart.astype(jnp.int32), fmax)
 
     scale_specs, scale_args = _scale_plumbing(
         kv_map, kv_chunk, block_s, k_scale, v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=len(prefetch),
         grid=(g, n_kc, n_blocks),
         in_specs=[
             pl.BlockSpec(
                 (1, kv_chunk, m_rows, d),
-                lambda i, kc, j, rows, pstart, fmax: (i, kc, 0, 0),
+                lambda i, kc, j, *_: (i, kc, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -466,7 +540,7 @@ def prefill_attention(
         ],
         out_specs=pl.BlockSpec(
             (1, kv_chunk, m_rows, d),
-            lambda i, kc, j, rows, pstart, fmax: (i, kc, 0, 0),
+            lambda i, kc, j, *_: (i, kc, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
@@ -478,15 +552,14 @@ def prefill_attention(
     kernel = functools.partial(
         _prefill_kernel,
         block_s=block_s, num_kv=kv_chunk, gq=gq, m_rows=m_rows,
-        scale=float(scale), kv_quant=kv_quant,
+        scale=float(scale), kv_quant=kv_quant, paged=paged,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((g, num_kv, m_rows, d), q.dtype),
         interpret=interpret,
-    )(rows.astype(jnp.int32), pstart.astype(jnp.int32), fmax,
-      qr, k_cache, v_cache, *scale_args)
+    )(*prefetch, qr, k_cache, v_cache, *scale_args)
     return out.reshape(g, num_kv, bq, gq, d).transpose(0, 2, 1, 3, 4) \
         .reshape(g, bq, qh, d)
 
@@ -494,10 +567,9 @@ def prefill_attention(
 def _tree_kernel(
     rows_ref,       # scalar prefetch: i32[T] cache row per token
     clens_ref,      # scalar prefetch: i32[T] committed cache depth per token
-    q_ref,          # [1, KV, gq, D] this token's queries
-    k_ref,          # [1, KV, Bs, D] committed-cache K block
-    v_ref,          # [1, KV, Bs, D]
-    *rest,          # [ks_ref, vs_ref,] sk_ref, sv_ref, bias_ref, o_ref,
+    *refs,          # [pt_ref (paged),] q_ref ([1, KV, gq, D] queries),
+                    # k_ref/v_ref ([1, KV, Bs, D] committed blocks),
+                    # [ks_ref, vs_ref,] sk_ref, sv_ref, bias_ref, o_ref,
                     # m/l/acc scratch — scale blocks only for int8 committed
                     # caches (the spec buffer stays in the compute dtype)
     block_s: int,
@@ -505,7 +577,11 @@ def _tree_kernel(
     gq: int,
     scale: float,
     kv_quant: bool,
+    paged: bool = False,
 ):
+    if paged:
+        refs = refs[1:]  # page table: index-map-only prefetch operand
+    q_ref, k_ref, v_ref, *rest = refs
     if kv_quant:
         ks_ref, vs_ref, sk_ref, sv_ref, bias_ref, o_ref, \
             m_ref, l_ref, acc_ref = rest
@@ -597,40 +673,64 @@ def _tree_kernel(
 
 
 def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
-               scale, block_s, interpret, k_scale=None, v_scale=None):
+               scale, block_s, interpret, k_scale=None, v_scale=None,
+               page_table=None, page_size=0):
     """Shared pallas_call for the tree kernel.
 
     ``qr``: [N, KV, G, D] query groups (N grid rows share one cache row);
     ``bias``: [N, Gb, Pp] pre-padded ancestor bias with Gb in {1, G}.
+    Only the COMMITTED cache pages (``page_table``); the spec buffers are
+    small per-request scratch rewritten every macro-step and stay
+    slot-contiguous.
     """
     n, num_kv, g, d = qr.shape
     s_len = k_cache.shape[2]
     p_len = k_spec.shape[2]
     pp = bias.shape[-1]
     kv_quant = k_scale is not None
+    paged = page_table is not None
     block_s = _fit_block_s(block_s, s_len, num_kv, d,
                            jnp.dtype(k_cache.dtype).itemsize, kv_quant,
                            _VMEM_BUDGET)
+    if paged:  # a seq-block must sit inside one page (see decode_attention)
+        block_s = math.gcd(block_s, page_size)
     n_blocks = s_len // block_s
 
-    def kv_map(i, j, rows, clens):
-        # clamp to the committed frontier so fully-masked blocks re-map to
-        # an already-fetched block (Pallas skips the copy)
-        limit = jnp.maximum(clens[i] - 1, 0) // block_s
-        return (rows[i], 0, jnp.minimum(j, limit), 0)
+    if paged:
+        ppr = s_len // page_size
 
-    def spec_map(i, j, rows, clens):
+        def kv_map(i, j, rows, clens, pt):
+            limit = jnp.maximum(clens[i] - 1, 0) // block_s
+            jc = jnp.minimum(j, limit)
+            prow, pblk = _page_coords(pt, rows[i], jc, block_s, page_size,
+                                      ppr)
+            return (prow, 0, pblk, 0)
+
+        prefetch = (rows.astype(jnp.int32),
+                    jnp.clip(clens, 0, s_len).astype(jnp.int32),
+                    page_table.astype(jnp.int32))
+    else:
+        def kv_map(i, j, rows, clens):
+            # clamp to the committed frontier so fully-masked blocks re-map
+            # to an already-fetched block (Pallas skips the copy)
+            limit = jnp.maximum(clens[i] - 1, 0) // block_s
+            return (rows[i], 0, jnp.minimum(j, limit), 0)
+
+        prefetch = (rows.astype(jnp.int32),
+                    jnp.clip(clens, 0, s_len).astype(jnp.int32))
+
+    def spec_map(i, j, rows, *_):
         return (rows[i], 0, 0, 0)
 
     scale_specs, scale_args = _scale_plumbing(
         kv_map, num_kv, block_s, k_scale, v_scale)
     gb = bias.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(n, n_blocks),
         in_specs=[
             pl.BlockSpec(
-                (1, num_kv, g, d), lambda i, j, rows, clens: (i, 0, 0, 0),
+                (1, num_kv, g, d), lambda i, j, *_: (i, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
@@ -647,12 +747,12 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
                 (1, num_kv, p_len, d), spec_map, memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, gb, pp), lambda i, j, rows, clens: (i, 0, 0),
+                (1, gb, pp), lambda i, j, *_: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, num_kv, g, d), lambda i, j, rows, clens: (i, 0, 0, 0),
+            (1, num_kv, g, d), lambda i, j, *_: (i, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
@@ -664,15 +764,14 @@ def _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens, bias,
     kernel = functools.partial(
         _tree_kernel,
         block_s=block_s, num_kv=num_kv, gq=g, scale=float(scale),
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, paged=paged,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, num_kv, g, d), qr.dtype),
         interpret=interpret,
-    )(rows.astype(jnp.int32), jnp.clip(clens, 0, s_len).astype(jnp.int32),
-      qr, k_cache, v_cache, *scale_args, k_spec, v_spec, bias)
+    )(*prefetch, qr, k_cache, v_cache, *scale_args, k_spec, v_spec, bias)
 
 
 def _pad_bias(amask):
@@ -686,7 +785,7 @@ def _pad_bias(amask):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_s", "interpret")
+    jax.jit, static_argnames=("scale", "block_s", "interpret", "page_size")
 )
 def tree_attention(
     q: jax.Array,        # [T, QH, D] (RoPE already applied)
@@ -702,6 +801,8 @@ def tree_attention(
     interpret: bool = False,
     k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8 committed-cache
     v_scale: Optional[jax.Array] = None,  # dequant scales (None = fp cache)
+    page_table: Optional[jax.Array] = None,  # i32[R+1, S//page_size]
+    page_size: int = 0,
 ) -> jax.Array:
     """Two-segment tree-verify attention (SpecInfer's TreeIncMHA hot loop).
 
@@ -724,12 +825,13 @@ def tree_attention(
     qr = q.reshape(t, num_kv, gq, d)
     bias = _pad_bias(amask)[:, None, :]  # [T, 1, Pp]
     out = _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens,
-                     bias, scale, block_s, interpret, k_scale, v_scale)
+                     bias, scale, block_s, interpret, k_scale, v_scale,
+                     page_table, page_size)
     return out.reshape(t, qh, d)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_s", "interpret")
+    jax.jit, static_argnames=("scale", "block_s", "interpret", "page_size")
 )
 def tree_attention_batched(
     q: jax.Array,        # [R, P, QH, D] per-request tree-token queries
@@ -745,6 +847,8 @@ def tree_attention_batched(
     interpret: bool = False,
     k_scale: Optional[jax.Array] = None,  # [R+1, KV, S] int8 committed-cache
     v_scale: Optional[jax.Array] = None,  # dequant scales (None = fp cache)
+    page_table: Optional[jax.Array] = None,  # i32[R+1, S//page_size]
+    page_size: int = 0,
 ) -> jax.Array:
     """Tree-verify attention for a FIXED [requests x tree-slots] layout.
 
@@ -764,6 +868,7 @@ def tree_attention_batched(
     # per-(slot, group) bias rows: [R, P, Pp] -> repeat gq -> [R, P*gq, Pp]
     bias = jnp.repeat(_pad_bias(amask), gq, axis=1)
     out = _tree_call(qr, k_cache, v_cache, k_spec, v_spec, rows, clens,
-                     bias, scale, block_s, interpret, k_scale, v_scale)
+                     bias, scale, block_s, interpret, k_scale, v_scale,
+                     page_table, page_size)
     return out.reshape(r, num_kv, p, gq, d).transpose(0, 2, 1, 3, 4) \
         .reshape(r, p, qh, d)

@@ -228,12 +228,27 @@ def _resolve_store(calibration):
     return store if store else None
 
 
-def _workload_knobs(feats: Optional[Dict], max_seq) -> Dict[str, float]:
+def _workload_knobs(feats: Optional[Dict], max_seq,
+                    kv_page_size: Optional[int] = None) -> Dict[str, float]:
     """Feature scalars -> the :func:`pp_serve_cost` pricing knobs — ONE
     derivation shared by :func:`search_serve_plan` and :func:`price_plan`,
     so the chooser and the replay/measured side price a workload
     identically (a modeling gap between them would launder into the
-    calibration store as fake machine skew)."""
+    calibration store as fake machine skew).
+
+    Paged-KV awareness (``kv_page_size``, serve/kv_paged.py):
+
+    * the KV stream rounds the mean live depth UP to whole pages — the
+      block-granular read bound (a request's cache moves page by page; the
+      tail page streams full whatever its fill), slightly err-high like
+      every capacity term here;
+    * the workload's ``shared_prefix_frac`` (fraction of binds that hit
+      the prefix cache) DISCOUNTS the prefill-side terms: shared prefixes
+      are prefilled once, so both the prefill-interference rate and the
+      TTFT prompt length shrink to the unshared share.  The decode-side
+      KV stream is NOT discounted — every request still reads the shared
+      pages for itself each step.
+    """
     knobs = {"kv_fill_frac": 1.0, "prefill_tok_per_s": 0.0,
              "prompt_len": 0.0, "out_len": 0.0}
     if not feats:
@@ -242,15 +257,20 @@ def _workload_knobs(feats: Optional[Dict], max_seq) -> Dict[str, float]:
     out_len = float(feats.get("mean_output_len", 0.0) or 0.0)
     rate = float(feats.get("arrival_rate_per_s", 0.0) or 0.0)
     occ = float(feats.get("mean_occupancy", 1.0) or 1.0)
-    knobs["prompt_len"] = prompt_len
+    shared = min(max(float(feats.get("shared_prefix_frac", 0.0) or 0.0),
+                     0.0), 1.0) if kv_page_size else 0.0
+    knobs["prompt_len"] = prompt_len * (1.0 - shared)
     knobs["out_len"] = out_len
-    knobs["prefill_tok_per_s"] = rate * prompt_len
+    knobs["prefill_tok_per_s"] = rate * prompt_len * (1.0 - shared)
     if max_seq:
         # mean causally-live depth per slot: the whole prompt plus half
         # the output (tokens accrue linearly over a decode); a cold
         # profile (0 fill) keeps the err-high full-capacity bound
+        depth = prompt_len + 0.5 * out_len
+        if kv_page_size and depth > 0:
+            depth = -(-depth // kv_page_size) * kv_page_size
         knobs["kv_fill_frac"] = min(
-            1.0, max(occ * (prompt_len + 0.5 * out_len) / max_seq, 0.0)
+            1.0, max(occ * depth / max_seq, 0.0)
         ) or 1.0
     return knobs
 
@@ -275,9 +295,17 @@ def search_serve_plan(
     telemetry=None,
     workload=None,
     calibration="auto",
+    kv_page_size=None,
 ) -> Dict:
     """Pick the best (tp, pp, n_micro) for serving ``model``'s graph on
     ``n_chips`` chips.
+
+    ``kv_page_size``: the deployment serves with the paged KV cache
+    (serve/kv_paged.py) — the KV stream prices block-granularly (live
+    depth rounds up to whole pages) and the workload's
+    ``shared_prefix_frac`` discounts the prefill-interference and TTFT
+    terms (shared prefixes are prefilled once).  None prices the
+    slot-contiguous layout exactly as before.
 
     ``telemetry``: optional :class:`~flexflow_tpu.obs.Telemetry` — the
     winning plan's predicted TPOT/bubble/transfer/memory are recorded in
@@ -353,7 +381,7 @@ def search_serve_plan(
     feats = _workload_features(workload)
     store = _resolve_store(calibration)
     rows = _graph_rows(graph, attn0)
-    knobs = _workload_knobs(feats, max_seq)
+    knobs = _workload_knobs(feats, max_seq, kv_page_size)
     kv_fill = knobs["kv_fill_frac"]
     prefill_rate = knobs["prefill_tok_per_s"]
     prompt_len = knobs["prompt_len"]
@@ -460,6 +488,8 @@ def search_serve_plan(
         candidates[f"tp{best['tp']}_pp{best['pp']}"]["memory_parts_gb"]
     if feats:
         best["workload"] = feats
+    if kv_page_size:
+        best["kv_page_size"] = int(kv_page_size)
     if store is not None:
         best["applied_scales"] = store.scales()
     if telemetry is not None and getattr(telemetry, "enabled", False):
@@ -495,6 +525,7 @@ def price_plan(
     devices=None,
     spec_name: Optional[str] = None,
     workload=None,
+    kv_page_size=None,
 ) -> Dict:
     """Price ONE tp x pp x m factorization with the same stage-split and
     cost machinery :func:`search_serve_plan` ranks with.
@@ -523,7 +554,8 @@ def price_plan(
     attn0 = next(n for n in graph.nodes
                  if isinstance(n.op, IncMultiHeadSelfAttention))
     knobs = _workload_knobs(_workload_features(workload),
-                            getattr(attn0.op, "cost_seq_len", None))
+                            getattr(attn0.op, "cost_seq_len", None),
+                            kv_page_size)
     knobs.pop("out_len")  # pricing knob only for the ranking objective
     cost = pp_serve_cost(
         plans, mm, n_micro=n_micro,

@@ -19,6 +19,7 @@ from .inference_manager import (
     searched_serve_strategy,
     tensor_parallel_strategy,
 )
+from .kv_paged import PagedKVAllocator, PagePoolExhausted, PageTable
 from .models.base import MODEL_REGISTRY, ServeModelConfig, build_model
 from .ops import (
     IncMultiHeadSelfAttention,

@@ -66,6 +66,7 @@ class LLM:
         dtype=None,
         devices=None,
         kv_dtype=None,
+        kv_page_size=None,
         telemetry=None,
         resilience=None,
         fault_injector=None,
@@ -76,6 +77,9 @@ class LLM:
         bandwidth and doubles context/batch capacity per HBM byte, which is
         what makes the full-depth Llama-2-7B shape (int8 weights via
         ``quantize_int8`` + int8 KV) admissible on one 16 GB chip.
+
+        ``kv_page_size`` enables the paged KV cache with copy-on-write
+        prefix sharing (``serve/kv_paged.py``; None = slot-contiguous).
 
         ``telemetry`` / ``resilience`` / ``fault_injector`` thread the
         observability handle and the resilient-serving policy layer
@@ -101,6 +105,7 @@ class LLM:
             topk=topk,
             outputs=logits,
             kv_dtype=kv_dtype,
+            kv_page_size=kv_page_size,
         )
         if self._sd is not None:
             params = convert_state_dict(self._sd, self.config, dtype or "float32")
@@ -124,6 +129,7 @@ class LLM:
                     devices=devices[:1],
                     tp=1,
                     kv_dtype=kv_dtype,
+                    kv_page_size=kv_page_size,
                 )
             self.rm = SpecInferManager(
                 self.im, ssm.im, gen, width=spec_width, depth=spec_depth,

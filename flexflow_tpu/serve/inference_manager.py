@@ -248,6 +248,7 @@ class InferenceManager:
         kv_dtype: Optional[str] = None,
         gate_lm_head: bool = True,
         prefill_overlap: bool = True,
+        kv_page_size: Optional[int] = None,
     ):
         """``model`` is an FFModel whose graph was built by a serve builder.
 
@@ -272,6 +273,22 @@ class InferenceManager:
         PrefillBatchConfigs carry ``logit_slots``), so it can be toggled
         between calls for ablation; decode/mixed/hand-built batches are
         never gated.
+
+        ``kv_page_size``: enable the PAGED KV cache (serve/kv_paged.py):
+        the same physical buffers are carved into fixed pages of this many
+        tokens, managed through a per-request block table with refcounted
+        copy-on-write prefix sharing — no fragmentation at high occupancy,
+        shared system prompts prefilled once.  Must divide ``max_seq_len``
+        AND its 128-lane pad (asserted at allocator construction) and be a
+        multiple of the prefill tile (asserted here).  None (default)
+        keeps the slot-contiguous allocator; both paths are bit-identical
+        (tests/test_kv_paged.py).  Writes require mapped pages: the
+        RequestManager prepares them before every dispatch
+        (``_kv_prepare``); callers driving ``step``/``decode_scan``
+        directly must call ``kv.bind(rid, slot=...)`` +
+        ``kv.prepare_write(rid, lo, hi)`` themselves — an unprepared
+        write lands in the scratch page (pad-token semantics), not an
+        error.
 
         ``prefill_overlap``: software-pipeline the prefill scan — chunk
         i+1's embedding→norm→layer-0 QKV projection is issued inside chunk
@@ -342,11 +359,18 @@ class InferenceManager:
         # — admission control, preemption pricing, and the memory ledger
         # all consult THIS object; ``self.state`` is a delegating property,
         # so the jitted step's donate/re-bind cycle is unchanged.
-        self.kv = KVAllocator(
-            [StageKV(model.graph.nodes, strategy, self.plan.mesh,
-                     max_requests, max_seq_len, max_spec_tokens)],
-            max_requests, max_seq_len,
-        )
+        # ``kv_page_size`` swaps in the paged allocator behind the same
+        # interface (serve/kv_paged.py).
+        stage_kv = [StageKV(model.graph.nodes, strategy, self.plan.mesh,
+                            max_requests, max_seq_len, max_spec_tokens)]
+        self.kv_page_size = kv_page_size
+        if kv_page_size:
+            from .kv_paged import PagedKVAllocator
+
+            self.kv = PagedKVAllocator(stage_kv, max_requests, max_seq_len,
+                                       page_size=kv_page_size)
+        else:
+            self.kv = KVAllocator(stage_kv, max_requests, max_seq_len)
         # Pallas decode/tree kernels: replace the cache-row-gather attention.
         # "auto" = on for TPU backends; under TP the attention op wraps the
         # kernel in shard_map over the kv-head axis (IncMultiHeadSelfAttention
@@ -375,6 +399,10 @@ class InferenceManager:
         # tile-aligned starts never clamp against the cache's seq capacity.
         self.prefill_tile = pick_prefill_tile(max_tokens_per_batch,
                                               max_seq_len)
+        if kv_page_size:
+            from .kv_paged import validate_page_tile
+
+            validate_page_tile(kv_page_size, self.prefill_tile)
         # fixed tree-token layout (rows, slots) registered by SpecDecodeScan
         # (one per InferenceManager); the layout is PASSED per step by the
         # scan, never applied to host-built tree batches
@@ -515,13 +543,15 @@ class InferenceManager:
         return sample_tokens(logits, sample)
 
     def _step_impl(self, params, state, bc, sample=None, tree_layout=None,
-                   qkv0=None):
+                   qkv0=None, pages=None):
         # ``tree_layout`` is passed ONLY by SpecDecodeScan, whose verify
         # batches are guaranteed slot-major [R, P]; host-built tree batches
         # (SpecInferManager) have variable layouts and must not take the
         # batched-kernel path.  ``qkv0`` (prefill software pipelining) is
         # this chunk's precomputed layer-0 q/k/v from the scan carry; only
-        # the marked qkv0_consumer attention op reads it.
+        # the marked qkv0_consumer attention op reads it.  ``pages`` is the
+        # paged-KV block table (kv_paged.PageTable) every attention op
+        # translates its cache coordinates through; None = slot-contiguous.
         base = bc if isinstance(bc, BatchConfig) else bc.base
         outs, new_state = self._fwd(
             params,
@@ -534,6 +564,7 @@ class InferenceManager:
                 "tree_layout": tree_layout
                 if not isinstance(bc, BatchConfig) else None,
                 "qkv0": qkv0,
+                "pages": pages,
             },
         )
         logits = outs[0].astype(jnp.float32)  # [T, vocab]
@@ -552,6 +583,12 @@ class InferenceManager:
             new_state,
         )
 
+    def _page_view(self):
+        """Current device-side block table (None = slot-contiguous).  Read
+        per dispatch — the RequestManager's pre-dispatch ``prepare_write``
+        calls may have remapped pages (allocation, COW) since last step."""
+        return self.kv.page_view()
+
     def step(self, bc, sample=None) -> InferenceResult:
         """Run one serving step; caches update in place (donated).
 
@@ -567,12 +604,13 @@ class InferenceManager:
         with self.telemetry.span("step_dispatch", cat="dispatch",
                                  track="dispatch"):
             result, self.state = self._step(self.params, self.state, bc,
-                                            sample)
+                                            sample, None, None,
+                                            self._page_view())
         return result
 
     # ------------------------------------------------------------------
-    def _decode_scan_impl(self, params, state, bc, sample, n_steps: int,
-                          eos: Optional[int]):
+    def _decode_scan_impl(self, params, state, bc, sample, pages,
+                          n_steps: int, eos: Optional[int]):
         """n_steps pure-decode steps as ONE on-device ``lax.scan``.
 
         TPU-first redesign of the reference's serving loop (§3.3): instead of
@@ -598,7 +636,11 @@ class InferenceManager:
                 else:
                     key, temperature, top_p = sample
                     stp = (jax.random.fold_in(key, i), temperature, top_p)
-            result, state = self._step_impl(params, state, bc, stp)
+            # the block table is CONSTANT across the scan: the manager's
+            # prepare_write pre-mapped (and COW-resolved) every page the
+            # n_steps positions can reach before dispatch
+            result, state = self._step_impl(params, state, bc, stp,
+                                            pages=pages)
             toks = result.token_ids
             live = alive  # emission validity for THIS step
             if eos is not None:
@@ -658,7 +700,8 @@ class InferenceManager:
         with self.telemetry.span("decode_scan_dispatch", cat="dispatch",
                                  track="dispatch", n_steps=n_steps):
             tokens, live, self.state, bc = self._scan(
-                self.params, self.state, bc, sample, n_steps=n_steps, eos=eos
+                self.params, self.state, bc, sample, self._page_view(),
+                n_steps=n_steps, eos=eos
             )
         if self.telemetry.enabled:
             self.telemetry.metrics.counter("decode_scan_steps").inc(n_steps)
@@ -692,11 +735,13 @@ class InferenceManager:
                 extras={
                     # mirror _step_impl's extras so an embedding/norm lower
                     # that consults any of them behaves identically here
+                    # (pages stays None: the prologue never touches caches)
                     "batch_config": bc,
                     "pallas_decode": self.use_pallas,
                     "pallas_interpret": self.pallas_interpret,
                     "tree_layout": None,
                     "qkv0": None,
+                    "pages": None,
                 },
             )
             [x] = step.node.op.lower(ctx, [x],
@@ -707,7 +752,7 @@ class InferenceManager:
             x, params.get(a_step.node.name, {}), bc)
 
     def _prefill_scan_impl(self, params, state, bcs, sample=None,
-                           overlap=False):
+                           pages=None, overlap=False):
         """A stack of prefill chunks as ONE on-device ``lax.scan``.
 
         The decode loop already scans (``decode_scan``); prefill was the one
@@ -742,7 +787,8 @@ class InferenceManager:
             elif sample is not None:
                 key, temperature, top_p = sample
                 stp = (jax.random.fold_in(key, i), temperature, top_p)
-            return self._step_impl(params, state, bc, stp, qkv0=qkv0)
+            return self._step_impl(params, state, bc, stp, qkv0=qkv0,
+                                   pages=pages)
 
         n = bcs.base.tokens.shape[0]
         idx = jnp.arange(n)
@@ -792,7 +838,7 @@ class InferenceManager:
                                  track="dispatch",
                                  n_chunks=int(bcs.base.tokens.shape[0])):
             tokens, self.state = self._pscan(
-                self.params, self.state, bcs, sample,
+                self.params, self.state, bcs, sample, self._page_view(),
                 overlap=bool(self.prefill_overlap
                              and self._overlap_steps is not None),
             )

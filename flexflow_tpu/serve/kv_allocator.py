@@ -206,6 +206,12 @@ class KVAllocator:
       (tests/test_kv_allocator.py pins all of r9's outcomes).
     """
 
+    # the slot-contiguous allocator: one reserved max_seq_len span per slot.
+    # The paged subclass (serve/kv_paged.py) flips this and overrides the
+    # page-granular hooks below behind the SAME bind/observe/release/
+    # bytes_per_token/capacity_bytes interface.
+    paged = False
+
     def __init__(self, stages: Sequence[StageKV], max_requests: int,
                  max_seq_len: int):
         self.stages = list(stages)
@@ -273,10 +279,37 @@ class KVAllocator:
                    for s in self.stages)
 
     # ---- per-request attribution --------------------------------------
-    def bind(self, rid: int) -> None:
-        """A request took a slot (admission or preemption-readmission)."""
+    def bind(self, rid: int, **_) -> Optional[Dict]:
+        """A request took a slot (admission or preemption-readmission).
+
+        The slot-contiguous allocator only starts attribution; the extra
+        keyword context the RequestManager supplies (``slot``, ``tokens``,
+        ``need``, ``align``) is consumed by the paged subclass, which maps
+        pages and returns a ``{"cached_tokens", "hit_pages"}`` prefix-reuse
+        dict (None here: nothing is ever pre-cached in a dedicated span).
+        """
         self._live.setdefault(int(rid), 0)
         self._peak.setdefault(int(rid), 0)
+        return None
+
+    def prepare_write(self, rid: int, lo: int, hi: int) -> None:
+        """Authorize cache writes at positions ``[lo, hi)`` for ``rid``
+        BEFORE the dispatch that performs them.  A no-op here — every
+        slot's span is pre-reserved — but the paged subclass allocates
+        missing pages and copy-on-writes shared ones, so serve loops call
+        this unconditionally through RequestManager._kv_prepare."""
+        return None
+
+    def round_need(self, tokens: int) -> int:
+        """Admission-gate granularity of a worst-case cache need: the
+        slot-contiguous gate prices exact positions; the paged subclass
+        rounds up to whole pages (a request can only hold page multiples)."""
+        return int(tokens)
+
+    def page_view(self):
+        """The device-side block-table pytree for the jitted step (None =
+        slot-contiguous addressing; see kv_paged.PageTable)."""
+        return None
 
     def observe(self, usage: Dict[int, int], telemetry=None) -> Dict:
         """One serve tick's live cache depths (``rid -> tokens`` for every

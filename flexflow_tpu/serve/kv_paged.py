@@ -1,0 +1,607 @@
+"""Paged KV cache with copy-on-write prefix sharing.
+
+The capacity multiplier the ROADMAP names: through r12 KV is
+slot-contiguous — every bound slot reserves ``max_seq_len`` positions of
+which only the live prefix is occupied, so high-occupancy serving
+fragments HBM and every request re-prefills its own copy of a fleet-wide
+system prompt.  This module brings the vLLM/PagedAttention block-table
+design (Kwon et al., SOSP'23) and SGLang/RadixAttention-style prefix
+reuse (Zheng et al.) to the TPU serve stack, **behind the exact r12
+KVAllocator interface** (``bind``/``observe``/``release``/
+``bytes_per_token``/``capacity_bytes``), so admission control, preemption
+pricing, the serve search, and the memory ledger keep consulting one
+arithmetic:
+
+* **Physical layout is unchanged.**  The cache buffers stay the
+  ``[max_requests+1, KV, S_pad, D]`` arrays the jitted step donates; the
+  allocator reinterprets each row's seq axis as ``S_pad / page_size``
+  fixed pages, so the pool holds ``(R+1) * S_pad / page_size`` pages and
+  a page id ``pid`` addresses ``(row, slot) = divmod(pid, pages_per_row)``
+  in EVERY buffer of every stage simultaneously (one logical table; the
+  per-stage pools are the per-stage physical planes, exactly the pp
+  capacity contract).  The int8 scale planes ``[rows, KV, S]`` page
+  alongside K/V — same (row, seq-range) coordinates, no separate table.
+* **Block-table indirection, not data movement.**  A per-cache-row table
+  ``i32[R+1, pages_per_row]`` maps logical page -> physical page.  The
+  Pallas decode/prefill/tree kernels gather the page base per kv-chunk
+  through a scalar-prefetched copy of the table
+  (``ops/pallas/attention.py``); the KV write paths and the gather
+  fallback translate (row, position) through the same table on device
+  (``serve/ops.py``).  Masks and positions stay logical, the fetched
+  values are identical, so the paged path is BIT-IDENTICAL to the
+  slot-contiguous path — the correctness contract tests/test_kv_paged.py
+  pins across decode/prefill/mixed/pp2/int8/spec.
+* **On-demand pages.**  ``prepare_write(rid, lo, hi)`` (called by the
+  RequestManager before every dispatch that writes) maps missing pages
+  from the free pool, so a request holds ``ceil(live/page)`` pages
+  instead of a ``max_seq_len`` span — ``kv_fragmentation_frac`` collapses
+  from the slot-reservation waste to intra-page tail waste (~0, the
+  headline before/after metric in ``obs/memory.py``).  Pool exhaustion
+  raises :class:`PagePoolExhausted`; under ``ResilienceConfig.preemption``
+  the manager preempts a victim, whose pages free page-granularly.
+* **Refcounted copy-on-write prefix sharing.**  Pages are keyed by a
+  chained hash of the page-aligned token prefix that produced them (KV at
+  a position is a pure function of the token prefix), plus a
+  partial-tail entry for the final non-aligned page.  ``bind`` maps the
+  longest registered chain into the new request's table (refcount++), so
+  N requests sharing a system prompt prefill it ONCE — the
+  RequestManager starts the newcomer's prefill at the cached offset and
+  TTFT collapses to the unshared suffix.  A write into a page another
+  request maps (``req_refs >= 2``) copies the page first (all stages, k/v
+  + int8 scales) and remaps the writer — divergence mid-decode lands on a
+  private copy while sharers keep the original.  The index itself holds a
+  reference so shared pages outlive their creator; index-only pages are
+  the eviction pool (LRU) when free pages run out.
+
+Why writes never corrupt a sharer: a request only ever READS positions at
+or below its own causal frontier, and it WRITES every position from its
+cached offset upward itself (prefill then decode, gapless); positions a
+mapped page carries beyond the matched prefix are therefore always masked
+(future) or already rewritten by the reader itself — and rewrites of
+matched positions store bit-identical values (same tokens, same
+positions, deterministic projection + quantizer).  COW is required
+exactly when TWO requests would interleave writes into one physical page.
+
+Everything here is host-side bookkeeping plus host-ORCHESTRATED device
+ops (the COW page copy, the table transfer); no policy decision is traced
+into a jitted program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .kv_allocator import KV_BUFFER_NAMES, KVAllocator, StageKV
+
+
+class PagePoolExhausted(RuntimeError):
+    """No free page and nothing evictable: the pool is over-committed.
+    RequestManager._kv_prepare turns this into page-pressure preemption
+    when ``ResilienceConfig.preemption`` is on; otherwise it propagates
+    (an admission gate sized with ``round_need`` prevents it)."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PageTable:
+    """The device-side view of the block table, shipped with each step
+    (``extras["pages"]``).  ``table[row, logical_page] = pid``;
+    ``divmod(pid, pages_per_row)`` addresses the physical (row, page-slot)
+    in every cache buffer.  Registered as a pytree so it rides jit args;
+    the static fields key compilation like PrefillBatchConfig.tile_size."""
+
+    table: Any                     # i32[R+1, pages_per_row]
+    page_size: int = dataclasses.field(metadata=dict(static=True))
+    pages_per_row: int = dataclasses.field(metadata=dict(static=True))
+
+
+class _Entry:
+    """One prefix-index record: a physical page whose content is keyed by
+    the token prefix that produced it.  ``tokens`` is the page's actual
+    registered token content — lookups VERIFY it (the chained hash is a
+    lookup accelerator, not a trust anchor: Python's int-tuple hash is
+    non-cryptographic, and a silent collision would map another prompt's
+    KV into an unrelated request)."""
+
+    __slots__ = ("pid", "lru", "tokens")
+
+    def __init__(self, pid: int, lru: int, tokens: Tuple[int, ...]):
+        self.pid = pid
+        self.lru = lru
+        self.tokens = tokens
+
+
+def validate_page_tile(page_size: int, prefill_tile: int) -> None:
+    """Construction-time contract shared by both managers: the tiled
+    prefill path writes each tile as ONE block DUS, so a tile straddling
+    a page boundary would scatter across two physical pages — fail here,
+    not inside a kernel grid (sibling of the page/max_seq_len asserts)."""
+    if page_size and page_size % prefill_tile:
+        raise ValueError(
+            f"kv_page_size {page_size} must be a multiple of the "
+            f"prefill tile {prefill_tile} (tile-aligned block KV "
+            "writes must not straddle a page boundary)")
+
+
+def _common_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n
+
+
+class PagedKVAllocator(KVAllocator):
+    """Block-table KV allocation with refcounted COW prefix sharing.
+
+    Drop-in behind the r12 interface; see the module docstring for the
+    design.  ``page_size`` defaults to 512 — the int8 dequant-fused
+    kernel's block fetch granularity, so a kernel seq-block is exactly
+    one page at production shapes.
+    """
+
+    paged = True
+
+    def __init__(self, stages: Sequence[StageKV], max_requests: int,
+                 max_seq_len: int, page_size: int = 512):
+        super().__init__(stages, max_requests, max_seq_len)
+        # satellite (mirror of the r6 prefill_tile divisibility fix): the
+        # page geometry is validated HERE, at construction, instead of
+        # failing deep inside a Pallas kernel grid — the page must tile
+        # both the logical span (max_seq_len) and the 128-lane-padded
+        # physical seq axis the buffers actually allocate.
+        s_pad = -(-max_seq_len // 128) * 128
+        if page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        if max_seq_len % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq_len "
+                f"{max_seq_len} (a request's logical span is whole pages)")
+        if s_pad % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide the 128-lane-padded "
+                f"cache seq axis {s_pad} (the physical pool is carved from "
+                "the padded buffers; a non-dividing page would straddle "
+                "the pad boundary inside the kernel grid)")
+        self.page_size = int(page_size)
+        self.seq_pad = s_pad
+        self.pages_per_row = s_pad // page_size
+        self.n_pages = (max_requests + 1) * self.pages_per_row
+        # row max_requests is the pad-token scratch row; ONE page of it
+        # stays permanently reserved as the scratch page every unmapped
+        # table entry points at (reads are causally masked, writes are
+        # discarded pad-token garbage) — the rest of the scratch row's
+        # pages join the pool, which is why the paged pool's capacity
+        # exceeds the slot-contiguous R * max_seq_len.
+        self.scratch_pid = max_requests * self.pages_per_row
+        # prefix-sharing / lifecycle counters (cumulative; snapshot()
+        # publishes them through the paged gauge vocabulary)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_reused = 0
+        self.cow_copies = 0
+        self.pages_evicted = 0
+        self._init_pool()
+
+    # ------------------------------------------------------------------
+    def _init_pool(self) -> None:
+        self._table = np.full((self.max_requests + 1, self.pages_per_row),
+                              self.scratch_pid, np.int32)
+        self._req_refs = np.zeros(self.n_pages, np.int32)
+        self._idx_refs = np.zeros(self.n_pages, np.int32)
+        # LIFO free pool, low pids first out (deterministic)
+        self._free: List[int] = [p for p in range(self.n_pages - 1, -1, -1)
+                                 if p != self.scratch_pid]
+        self._slot_of: Dict[int, int] = {}
+        self._chain: Dict[int, Dict] = {}
+        # prefix index: ("f", chain_hash) -> full-page entry;
+        # ("p", chain_hash, tail_tuple) -> partial-tail entry.
+        # _partial_by_base buckets the partial keys per chain hash so a
+        # bind's tail lookup scans its own bucket, not the whole index.
+        self._entries: Dict[Tuple, _Entry] = {}
+        self._partial_by_base: Dict[int, List[Tuple]] = {}
+        self._key_of_pid: Dict[int, Tuple] = {}
+        # pid -> protected extent (page offsets [0, n) whose content the
+        # index vouches for): a write into a protected range by ANYONE
+        # must copy-on-write, or the index would serve corrupted KV to
+        # later matching binds (a sole-holder sharer diverging inside the
+        # registered range is the dangerous case — see prepare_slot_span)
+        self._protected: Dict[int, int] = {}
+        self._lru_tick = 0
+        self._device_table = None
+
+    def allocate(self):
+        """(Re)allocate zeroed buffers AND reset the page pool: zeroed
+        caches invalidate every indexed page's content, so the prefix
+        index must not survive a reallocation."""
+        out = super().allocate()
+        self._init_pool()
+        return out
+
+    def reset_attribution(self) -> None:
+        """New serving session over the SAME buffers (rids restart): every
+        request mapping releases, but the prefix index stays — its pages'
+        content is still valid, so a fleet-wide prompt survives manager
+        turnover (the whole point of index-held references)."""
+        for rid in list(self._slot_of):
+            self.release(rid)
+        super().reset_attribution()
+
+    # ------------------------------------------------------------------
+    def _touch(self, key: Tuple) -> None:
+        self._lru_tick += 1
+        self._entries[key].lru = self._lru_tick
+
+    def _invalidate_device(self) -> None:
+        self._device_table = None
+
+    def page_view(self) -> PageTable:
+        """Device-side table pytree (cached; rebuilt after any mutation)."""
+        if self._device_table is None:
+            import jax.numpy as jnp
+
+            self._device_table = PageTable(
+                table=jnp.asarray(self._table),
+                page_size=self.page_size,
+                pages_per_row=self.pages_per_row,
+            )
+        return self._device_table
+
+    # ---- pool primitives ----------------------------------------------
+    def _alloc_page(self) -> int:
+        if self._free:
+            return self._free.pop()
+        # evict least-recently-used index-only pages (no request maps them)
+        victims = sorted(
+            (e.lru, key) for key, e in self._entries.items()
+            if self._req_refs[e.pid] == 0)
+        if not victims:
+            raise PagePoolExhausted(
+                f"page pool exhausted: {self.n_pages - 1} pages all held by "
+                "live requests (admission should gate on round_need; "
+                "enable ResilienceConfig.preemption for page-pressure "
+                "eviction)")
+        _, key = victims[0]
+        self._drop_entry(key)
+        self.pages_evicted += 1
+        return self._free.pop()
+
+    def _drop_entry(self, key: Tuple) -> None:
+        e = self._entries.pop(key)
+        if key[0] == "p":
+            bucket = self._partial_by_base.get(key[1], [])
+            if key in bucket:
+                bucket.remove(key)
+            if not bucket:
+                self._partial_by_base.pop(key[1], None)
+        self._key_of_pid.pop(e.pid, None)
+        self._protected.pop(e.pid, None)
+        self._idx_refs[e.pid] = 0
+        if self._req_refs[e.pid] == 0:
+            self._free.append(e.pid)
+
+    def _map(self, slot: int, k: int, pid: int) -> None:
+        self._table[slot, k] = pid
+        self._req_refs[pid] += 1
+        self._invalidate_device()
+
+    def _unmap(self, slot: int, k: int) -> None:
+        pid = int(self._table[slot, k])
+        if pid == self.scratch_pid:
+            return
+        self._table[slot, k] = self.scratch_pid
+        self._req_refs[pid] -= 1
+        if self._req_refs[pid] == 0 and self._idx_refs[pid] == 0:
+            self._free.append(pid)
+        self._invalidate_device()
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        """Device copy of one page's content (k/v + int8 scale planes)
+        across EVERY stage's buffers — the COW data move.  Host-orchestrated
+        lax slice/update with concrete indices; the updated arrays re-bind
+        into the stage state dicts the next jitted step donates."""
+        ps = self.page_size
+        sr, ss = divmod(src, self.pages_per_row)
+        dr, ds = divmod(dst, self.pages_per_row)
+        for stage in self.stages:
+            state = stage.state
+            if not state:
+                continue
+            for bufs in state.values():
+                for name in list(bufs):
+                    if name not in KV_BUFFER_NAMES:
+                        continue
+                    arr = bufs[name]
+                    tail = (0,) * (arr.ndim - 3)
+                    blk = jax.lax.dynamic_slice(
+                        arr, (sr, 0, ss * ps) + tail,
+                        (1, arr.shape[1], ps) + arr.shape[3:])
+                    bufs[name] = jax.lax.dynamic_update_slice(
+                        arr, blk, (dr, 0, ds * ps) + tail)
+
+    # ---- the r12 interface, page-granular -----------------------------
+    def bind(self, rid: int, slot: Optional[int] = None, tokens=None,
+             need: Optional[int] = None, align: int = 1,
+             **_) -> Optional[Dict]:
+        """Map a request into the table, reusing every registered prefix
+        page its fed-token sequence matches.
+
+        ``slot``: the cache row (required for mapping; a bare ``bind(rid)``
+        degrades to attribution-only, the base behavior).  ``tokens``: the
+        sequence prefill will feed (prompt, or prompt+generated on
+        preemption readmission — KV is a pure function of it, so the chain
+        hash covers recompute reuse too).  ``align``: the prefill tile —
+        the returned ``cached_tokens`` is rounded down to it so the tiled
+        prefill path's tile-aligned-start contract holds when the manager
+        resumes feeding at the cached offset.
+
+        Returns ``{"cached_tokens", "hit_pages"}``; ``cached_tokens`` is
+        capped at ``len(tokens) - 1`` so the final fed position is always
+        recomputed (its logits are the first-token sample point).
+        """
+        super().bind(rid)
+        if slot is None:
+            return None
+        rid, slot = int(rid), int(slot)
+        self._slot_of[rid] = slot
+        toks = [int(t) for t in (tokens or [])]
+        ps = self.page_size
+        hashes: List[int] = []
+        h = 0
+        for k in range(len(toks) // ps):
+            h = hash((h, tuple(toks[k * ps:(k + 1) * ps])))
+            hashes.append(h)
+        info = {"tokens": toks, "hashes": hashes, "written_hi": 0,
+                "registered": 0, "tail_done": False}
+        self._chain[rid] = info
+
+        # longest registered full-page chain — each hit VERIFIES the
+        # entry's stored tokens against the bind's own page (the chained
+        # hash only routes the lookup; a non-cryptographic collision must
+        # read as a miss, never as someone else's KV)
+        hit_pids: List[int] = []
+        for k, h_k in enumerate(hashes):
+            e = self._entries.get(("f", h_k))
+            if e is None or e.tokens != tuple(toks[k * ps:(k + 1) * ps]):
+                break
+            hit_pids.append(e.pid)
+        cached_pages = len(hit_pids)
+        cached = cached_pages * ps
+        # partial-tail extension under the last matched chain hash: the
+        # best entry is the one sharing the longest token prefix with the
+        # remaining feed (content beyond the match is causally masked for
+        # the reader — see the module docstring's safety argument)
+        h_base = hashes[cached_pages - 1] if cached_pages else 0
+        part_pid, best_c, part_key = None, 0, None
+        for key in self._partial_by_base.get(h_base, ()):
+            c = _common_prefix_len(key[2], toks[cached:])
+            if c > best_c:
+                best_c, part_pid, part_key = c, self._entries[key].pid, key
+        usable = cached + best_c
+        if toks:
+            usable = min(usable, len(toks) - 1)
+        if align > 1:
+            usable -= usable % align
+        if usable <= 0:
+            if toks:  # a tokenless bind (attribution/on-demand pages
+                      # only, e.g. the spec draft cache) is not a miss
+                self.prefix_misses += 1
+            return {"cached_tokens": 0, "hit_pages": 0}
+        # map only the pages the resumed feed READS (those overlapping
+        # [0, usable)); the page containing the resume point will be
+        # partially re-fed — value-identical rewrites, COW if contended
+        n_full = min(cached_pages, -(-usable // ps))
+        for k in range(n_full):
+            self._map(slot, k, hit_pids[k])
+            self._touch(("f", hashes[k]))
+        mapped = n_full
+        if part_pid is not None and usable > cached:
+            self._map(slot, cached_pages, part_pid)
+            self._touch(part_key)
+            mapped += 1
+        info["written_hi"] = usable
+        self.prefix_hits += 1
+        self.prefix_tokens_reused += usable
+        return {"cached_tokens": usable, "hit_pages": mapped}
+
+    def _register(self, rid: int, info: Optional[Dict]) -> None:
+        """Publish ``rid``'s finished pages into the prefix index: full
+        pages once their span is written, the partial tail once the whole
+        fed sequence is written (its content is then exactly the fed
+        tokens — later decode writes only dirty positions BEYOND the
+        matchable range, which lookups never trust)."""
+        if info is None:
+            return
+        slot = self._slot_of.get(rid)
+        if slot is None:
+            return
+        ps = self.page_size
+        wh = info["written_hi"]
+        hashes = info["hashes"]
+        while (info["registered"] < len(hashes)
+               and (info["registered"] + 1) * ps <= wh):
+            k = info["registered"]
+            self._register_entry(
+                ("f", hashes[k]), int(self._table[slot, k]),
+                tuple(info["tokens"][k * ps:(k + 1) * ps]), ps)
+            info["registered"] += 1
+        n_full = len(hashes)
+        tail = tuple(info["tokens"][n_full * ps:])
+        if (not info["tail_done"] and tail and wh >= len(info["tokens"])
+                and info["registered"] == n_full
+                and n_full < self.pages_per_row):
+            h_base = hashes[-1] if hashes else 0
+            self._register_entry(("p", h_base, tail),
+                                 int(self._table[slot, n_full]),
+                                 tail, len(tail))
+            info["tail_done"] = True
+
+    def _register_entry(self, key: Tuple, pid: int,
+                        tokens: Tuple[int, ...], protected: int) -> None:
+        """``protected``: page offsets [0, n) whose content the entry
+        vouches for — any later write below it copy-on-writes (see
+        prepare_slot_span)."""
+        if pid == self.scratch_pid:
+            return
+        if key in self._entries or pid in self._key_of_pid:
+            return  # same content already indexed, or page already keyed
+        self._lru_tick += 1
+        self._entries[key] = _Entry(pid, self._lru_tick, tokens)
+        if key[0] == "p":
+            self._partial_by_base.setdefault(key[1], []).append(key)
+        self._key_of_pid[pid] = key
+        self._idx_refs[pid] = 1
+        self._protected[pid] = int(protected)
+
+    def prepare_write(self, rid: int, lo: int, hi: int) -> None:
+        """Make positions ``[lo, hi)`` of ``rid``'s row writable: allocate
+        unmapped logical pages from the pool, copy-on-write pages another
+        request maps.  Also the registration hook — content below the
+        request's write frontier is final exactly here, BEFORE the next
+        dispatch's writes, so pages publish with deterministic timing
+        (a request's tail page registers at its first decode-write
+        prepare; its own next write then COWs it away if someone mapped
+        it meanwhile — divergence-mid-decode)."""
+        rid = int(rid)
+        slot = self._slot_of.get(rid)
+        info = self._chain.get(rid)
+        if slot is None or hi <= lo:
+            return
+        self._register(rid, info)
+        self.prepare_slot_span(slot, lo, hi)
+        if info is not None and hi > info["written_hi"]:
+            info["written_hi"] = int(hi)
+
+    def prepare_slot_span(self, slot: int, lo: int, hi: int) -> None:
+        """Slot-addressed page mapping + COW for writes at ``[lo, hi)`` —
+        the rid-less half of :meth:`prepare_write`, used directly by the
+        on-device spec scan (which advances committed depths without
+        per-step host boundaries, so it prepares each slot's worst-case
+        span up front and skips the prefix-registration hook).
+
+        COW fires when (a) another REQUEST maps the page, or (b) the
+        write starts inside an index entry's PROTECTED extent.  (b) is
+        load-bearing even for a sole holder: a request that mapped a
+        registered page on a SHORTER match than the entry's (its tokens
+        diverge inside the protected range) would otherwise overwrite
+        content the index still vouches for, silently corrupting every
+        later bind that matches the full entry.  A registrant's own
+        forward writes start AT the protected boundary (offset ==
+        extent), so the common decode path never pays the copy.
+        """
+        if hi <= lo:
+            return
+        ps = self.page_size
+        for k in range(int(lo) // ps,
+                       min((int(hi) - 1) // ps, self.pages_per_row - 1) + 1):
+            pid = int(self._table[slot, k])
+            if pid == self.scratch_pid:
+                self._map(slot, k, self._alloc_page())
+                continue
+            off_lo = max(int(lo) - k * ps, 0)  # first written page offset
+            protected = (self._protected.get(pid, 0)
+                         if self._idx_refs[pid] else 0)
+            if self._req_refs[pid] > 1 or off_lo < protected:
+                dst = self._alloc_page()
+                self._copy_page(pid, dst)
+                self._unmap(slot, k)
+                self._map(slot, k, dst)
+                self.cow_copies += 1
+
+    def release(self, rid: int, tokens: Optional[int] = None) -> float:
+        """Unmap every page of the request's row (refcount--, zero-ref
+        unindexed pages return to the pool) after a final registration
+        pass, so a completed request's shareable prefix outlives it."""
+        rid = int(rid)
+        info = self._chain.pop(rid, None)
+        if info is not None:
+            self._register(rid, info)  # before the slot mapping drops
+        slot = self._slot_of.pop(rid, None)
+        if slot is not None:
+            for k in range(self.pages_per_row):
+                self._unmap(slot, k)
+        return super().release(rid, tokens)
+
+    # ---- capacity / headroom, page-granular ---------------------------
+    @property
+    def capacity_tokens(self) -> int:
+        """Token capacity of the page POOL (every non-scratch page times
+        the page size) — any mix of requests can occupy it, which is the
+        capacity-multiplier half of paging: the slot-contiguous cache
+        could only ever fill R * max_seq_len of the same buffers."""
+        return (self.n_pages - 1) * self.page_size
+
+    def round_need(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_size) * self.page_size
+
+    def pages_held(self) -> int:
+        """Pages currently mapped by live requests."""
+        return int((self._req_refs > 0).sum())
+
+    def pages_shared(self) -> int:
+        """Pages with more than one holder (requests + index)."""
+        return int(((self._req_refs + self._idx_refs) >= 2).sum())
+
+    def snapshot(self, _per_tok: Optional[float] = None,
+                 _live: Optional[int] = None) -> Dict:
+        """The contiguous snapshot plus the page-pool vocabulary.
+        Fragmentation becomes honest under paging: allocated-but-idle is
+        only the intra-page tail waste of each request's last page, not a
+        whole reserved slot span."""
+        snap = super().snapshot(_per_tok, _live)
+        per_tok = snap["capacity_bytes"] / max(self.capacity_tokens, 1)
+        held = self.pages_held()
+        live = snap["live_tokens"]
+        free = len(self._free)
+        evictable = sum(1 for e in self._entries.values()
+                        if self._req_refs[e.pid] == 0)
+        snap.update({
+            "fragmentation_frac": (1.0 - live / (held * self.page_size)
+                                   if held else 0.0),
+            # free + evictable is what a new request can actually get
+            "headroom_bytes": (free + evictable) * self.page_size * per_tok,
+            "page_size": self.page_size,
+            "pages_total": self.n_pages - 1,
+            "pages_live": held,
+            "pages_shared": self.pages_shared(),
+            "pages_free": free,
+            "pages_indexed": len(self._entries),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_tokens_reused": self.prefix_tokens_reused,
+            "cow_copies": self.cow_copies,
+            "pages_evicted": self.pages_evicted,
+        })
+        return snap
+
+    # ---- diagnostics ---------------------------------------------------
+    def logical_state(self, slot: int, depth: Optional[int] = None) -> Dict:
+        """Reconstruct one slot's logical cache rows through the table
+        (numpy; the bit-identity tests compare this against the
+        slot-contiguous run's rows).  ``depth`` truncates to the live
+        prefix — positions beyond a request's frontier are unmapped or
+        junk by design."""
+        ps, ppr = self.page_size, self.pages_per_row
+        pids = self._table[slot]
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for si, stage in enumerate(self.stages):
+            state = stage.state or {}
+            for node, bufs in state.items():
+                got: Dict[str, np.ndarray] = {}
+                for name, arr in bufs.items():
+                    if name not in KV_BUFFER_NAMES:
+                        continue
+                    a = np.asarray(arr)
+                    parts = []
+                    for pid in pids:
+                        r, s = divmod(int(pid), ppr)
+                        parts.append(a[r, :, s * ps:(s + 1) * ps])
+                    row = np.concatenate(parts, axis=1)
+                    got[name] = row[:, :depth] if depth is not None else row
+                out[node] = got
+        return out

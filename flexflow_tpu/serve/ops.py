@@ -46,6 +46,49 @@ from .batch_config import (
 
 NEG_INF = -1e30
 
+
+def _page_rows_pos(pages, rows, pos):
+    """Translate LOGICAL cache coordinates (row, position) to PHYSICAL ones
+    through a paged-KV block table (serve/kv_paged.py's ``PageTable``,
+    shipped per step at ``ctx.extras["pages"]``).
+
+    The physical buffers keep the slot-contiguous ``[R+1, KV, S, D]``
+    shape; a page id addresses ``(row, page-slot) = divmod(pid,
+    pages_per_row)``, so every existing write path (DUS chain, scatter,
+    per-tile block DUS) runs unchanged on the translated coordinates —
+    the indirection is pure index arithmetic, which is what makes the
+    paged path bit-identical to the contiguous one.
+    """
+    ps, ppr = pages.page_size, pages.pages_per_row
+    rows = jnp.clip(rows.astype(jnp.int32), 0, pages.table.shape[0] - 1)
+    col = jnp.clip(pos.astype(jnp.int32) // ps, 0, ppr - 1)
+    pid = pages.table[rows, col]
+    return pid // ppr, (pid % ppr) * ps + pos.astype(jnp.int32) % ps
+
+
+def _gather_logical_rows(cache, pages, rows):
+    """``cache[rows]`` reconstructed through the block table: each token's
+    LOGICAL cache row assembled from its physical pages ([T, KV, S(, D)]).
+    The materialization cost matches the slot-contiguous gather fallback
+    this replaces — it is the oracle path the Pallas kernels' in-VMEM
+    indirection is tested against."""
+    ps, ppr = pages.page_size, pages.pages_per_row
+    r1 = cache.shape[0]
+    pids = pages.table[jnp.clip(rows.astype(jnp.int32), 0,
+                                pages.table.shape[0] - 1)]  # [T, ppr]
+    prow, pslot = pids // ppr, pids % ppr
+    if cache.ndim == 4:
+        kvh, s, d = cache.shape[1:]
+        cr = cache.reshape(r1, kvh, ppr, ps, d)
+        # advanced indices split by a slice: indexed dims lead -> [T, ppr,
+        # KV, ps, D]
+        pg = cr[prow, :, pslot]
+        return pg.transpose(0, 2, 1, 3, 4).reshape(rows.shape[0], kvh, s, d)
+    kvh, s = cache.shape[1:]
+    cr = cache.reshape(r1, kvh, ppr, ps)
+    pg = cr[prow, :, pslot]                      # [T, ppr, KV, ps]
+    return pg.transpose(0, 2, 1, 3).reshape(rows.shape[0], kvh, s)
+
 # token-count cutoff between the per-token dynamic-update-slice chain and a
 # single XLA scatter for KV-cache writes (see _scatter_rows_pos).  The
 # switch is on the CAPACITY-PADDED batch length (max_tokens_per_batch),
@@ -270,7 +313,8 @@ class IncMultiHeadSelfAttention(Op):
             q, k, v = self.project_qkv(x, params, bc)
 
         if isinstance(bc, TreeVerifyBatchConfig):
-            state = self._commit(state, bc)
+            state = self._commit(state, bc,
+                                 ctx.extras.get("pages") if ctx else None)
             out, state = self._tree_attend(q, k, v, state, bc, ctx)
         elif isinstance(bc, TreeSearchBatchConfig):
             out, state = self._tree_attend(q, k, v, state, bc, ctx)
@@ -427,10 +471,15 @@ class IncMultiHeadSelfAttention(Op):
             )
         return cache
 
-    def _write_kv(self, state, rows, pos, k, v):
+    def _write_kv(self, state, rows, pos, k, v, pages=None):
         """Write this step's K/V vectors into the committed caches,
         quantizing on write when the caches are int8.  Returns the updated
-        buffers as a dict of the state keys that changed."""
+        buffers as a dict of the state keys that changed.  ``pages``
+        (paged KV) translates the logical (row, position) coordinates to
+        physical ones first — the scale planes ride the SAME translation,
+        so int8 scales page alongside their K/V values."""
+        if pages is not None:
+            rows, pos = _page_rows_pos(pages, rows, pos)
         kc, vc = state["k"], state["v"]
         if kc.dtype == jnp.int8:
             kq, ks = self._kv_quant(k)
@@ -447,13 +496,14 @@ class IncMultiHeadSelfAttention(Op):
         }
 
     @staticmethod
-    def _dequant_rows(cache_tok, scale_cache, rows, dtype):
-        """Gather-path dequant: ``cache_tok`` = cache[rows] ([T, KV, S, D]
-        int8), scales gathered the same way.  The materialization is
+    def _dequant_rows(cache_tok, sc_tok, dtype):
+        """Gather-path dequant: ``cache_tok`` = the gathered [T, KV, S, D]
+        int8 rows, ``sc_tok`` their [T, KV, S] scales gathered the same way
+        (logical reconstruction under paging).  The materialization is
         acceptable here — this is the fallback/oracle path; the Pallas
         kernels fuse the same math in VMEM."""
-        sc = scale_cache[rows]  # [T, KV, S]
-        return (cache_tok.astype(jnp.float32) * sc[..., None]).astype(dtype)
+        return (cache_tok.astype(jnp.float32)
+                * sc_tok[..., None]).astype(dtype)
 
     @staticmethod
     def _gather_rows_pos(cache, rows, pos):
@@ -509,7 +559,8 @@ class IncMultiHeadSelfAttention(Op):
         nreq = kc.shape[0] - 1
         rows = self._rows(bc, nreq)
         pos = bc.token_position
-        writes = self._write_kv(state, rows, pos, k, v)
+        pages = ctx.extras.get("pages") if ctx is not None else None
+        writes = self._write_kv(state, rows, pos, k, v, pages)
         kc, vc = writes["k"], writes["v"]
         kv_q = kc.dtype == jnp.int8
         if ctx is not None and ctx.extras.get("pallas_decode"):
@@ -528,9 +579,13 @@ class IncMultiHeadSelfAttention(Op):
                 self.num_kv_heads, self.q_per_kv
             )  # [KV, gq]: shardable over the kv-head dim
             scales = (writes["k_scale"], writes["v_scale"]) if kv_q else ()
+            pg = (pages.table,) if pages is not None else ()
+            pg_size = pages.page_size if pages is not None else 0
 
-            def attend(q_, kc_, vc_, rows_, pos_, slopes_, *scales_):
+            def attend(q_, kc_, vc_, rows_, pos_, slopes_, *rest):
                 kv_l, gq = q_.shape[1], q_.shape[2]
+                scales_ = rest[:len(scales)]
+                pt_ = rest[len(scales)] if pg else None
                 return decode_attention(
                     q_.reshape(t, kv_l * gq, self.head_dim),
                     kc_, vc_, rows_, pos_,
@@ -539,27 +594,37 @@ class IncMultiHeadSelfAttention(Op):
                     use_alibi=self.use_alibi, interpret=interp,
                     k_scale=scales_[0] if scales_ else None,
                     v_scale=scales_[1] if scales_ else None,
+                    page_table=pt_, page_size=pg_size,
                 ).reshape(t, kv_l, gq, self.head_dim)
 
             h = self._config_head_axes(ctx)
             sm = self._head_shard_map(
                 ctx, h,
                 [P(None, h), P(None, h), P(None, h), P(), P(), P(h)]
-                + [P(None, h)] * len(scales),
+                + [P(None, h)] * len(scales) + [P()] * len(pg),
                 P(None, h),
             )
             if sm is not None:
-                out = sm(attend)(q, kc, vc, rows, pos, slopes, *scales)
+                out = sm(attend)(q, kc, vc, rows, pos, slopes, *scales, *pg)
                 out = out.reshape(t, self.num_q_heads, self.head_dim)
                 new_state = dict(state)
                 new_state.update(writes)
                 return out, new_state
-        # fallback: gather each token's cache row: [T, KV, S, D]
-        k_tok = kc[rows]
-        v_tok = vc[rows]
+        # fallback: gather each token's cache row: [T, KV, S, D] (logical
+        # reconstruction through the block table under paging)
+        if pages is not None:
+            k_tok = _gather_logical_rows(kc, pages, rows)
+            v_tok = _gather_logical_rows(vc, pages, rows)
+        else:
+            k_tok = kc[rows]
+            v_tok = vc[rows]
         if kv_q:  # dequant (the Pallas path fuses this in-kernel instead)
-            k_tok = self._dequant_rows(k_tok, writes["k_scale"], rows, q.dtype)
-            v_tok = self._dequant_rows(v_tok, writes["v_scale"], rows, q.dtype)
+            ks_tok = (_gather_logical_rows(writes["k_scale"], pages, rows)
+                      if pages is not None else writes["k_scale"][rows])
+            vs_tok = (_gather_logical_rows(writes["v_scale"], pages, rows)
+                      if pages is not None else writes["v_scale"][rows])
+            k_tok = self._dequant_rows(k_tok, ks_tok, q.dtype)
+            v_tok = self._dequant_rows(v_tok, vs_tok, q.dtype)
         s = k_tok.shape[2]
         # causal over absolute positions (covers prefill + decode uniformly)
         mask = jnp.arange(s)[None, :] <= pos[:, None]  # [T, S]
@@ -611,6 +676,7 @@ class IncMultiHeadSelfAttention(Op):
         nreq = kc.shape[0] - 1
         rows = self._rows(base, nreq)
         pos = base.token_position
+        pages = ctx.extras.get("pages") if ctx is not None else None
 
         t = q.shape[0]
         bq = bc.tile_size
@@ -621,7 +687,8 @@ class IncMultiHeadSelfAttention(Op):
         sm = self._head_shard_map(
             ctx, h,
             [P(None, h), P(None, h), P(None, h), P(), P()]
-            + [P(None, h)] * (2 if kv_q else 0),
+            + [P(None, h)] * (2 if kv_q else 0)
+            + [P()] * (1 if pages is not None else 0),
             P(None, h),
         )
         if sm is None:  # unsupported sharding: flat gather fallback
@@ -630,6 +697,14 @@ class IncMultiHeadSelfAttention(Op):
         # row nreq (the largest index), so min() recovers the tile's request
         tile_rows = jnp.min(rows.reshape(g, bq), axis=1)
         pstart = pos.reshape(g, bq)[:, 0]
+        if pages is not None:
+            # physical coordinates for the per-tile block DUS: a tile sits
+            # inside ONE page (tile-aligned start, tile divides page — the
+            # manager validates page % prefill_tile == 0), so translating
+            # the tile's start translates the whole block
+            w_rows, w_start = _page_rows_pos(pages, tile_rows, pstart)
+        else:
+            w_rows, w_start = tile_rows, pstart
         # KV-cache write as G per-tile BLOCK dynamic-update-slices instead of
         # a flat-token scatter: a prefill chunk carries max_tokens (>
         # DUS_MAX_TOKENS) tokens, so _scatter_rows_pos would take the XLA
@@ -673,7 +748,7 @@ class IncMultiHeadSelfAttention(Op):
         vb = jnp.where(valid, vb, 0)
         zero = jnp.int32(0)
         for i in range(g):
-            at = (tile_rows[i], zero, pstart[i], zero)
+            at = (w_rows[i], zero, w_start[i], zero)
             kc = jax.lax.dynamic_update_slice(kc, kb[i][None], at)
             vc = jax.lax.dynamic_update_slice(vc, vb[i][None], at)
             if kv_q:
@@ -682,9 +757,13 @@ class IncMultiHeadSelfAttention(Op):
                 vsc = jax.lax.dynamic_update_slice(
                     vsc, vsb[i][None], at[:3])
         scales = (ksc, vsc) if kv_q else ()
+        pg = (pages.table,) if pages is not None else ()
+        pg_size = pages.page_size if pages is not None else 0
 
-        def attend(q_, kc_, vc_, rows_, pstart_, *scales_):
+        def attend(q_, kc_, vc_, rows_, pstart_, *rest):
             kv_l, gq = q_.shape[1], q_.shape[2]
+            scales_ = rest[:len(scales)]
+            pt_ = rest[len(scales)] if pg else None
             return prefill_attention(
                 q_.reshape(t, kv_l * gq, self.head_dim).reshape(
                     g, bq, kv_l * gq, self.head_dim
@@ -693,9 +772,10 @@ class IncMultiHeadSelfAttention(Op):
                 scale=self.scaling_factor, interpret=interp,
                 k_scale=scales_[0] if scales_ else None,
                 v_scale=scales_[1] if scales_ else None,
+                page_table=pt_, page_size=pg_size,
             ).reshape(t, kv_l, gq, self.head_dim)
 
-        out = sm(attend)(q, kc, vc, tile_rows, pstart, *scales)
+        out = sm(attend)(q, kc, vc, tile_rows, pstart, *scales, *pg)
         out = out.reshape(t, self.num_q_heads, self.head_dim)
         new_state = dict(state)
         new_state["k"], new_state["v"] = kc, vc
@@ -703,7 +783,7 @@ class IncMultiHeadSelfAttention(Op):
             new_state["k_scale"], new_state["v_scale"] = ksc, vsc
         return out, new_state
 
-    def _commit(self, state, bc: TreeVerifyBatchConfig):
+    def _commit(self, state, bc: TreeVerifyBatchConfig, pages=None):
         """Copy accepted speculative KV (spec buffer → committed cache).
 
         Reference: the ``committed_tokens`` handling at the top of
@@ -718,7 +798,9 @@ class IncMultiHeadSelfAttention(Op):
         # buffers hold compute-dtype KV; with an int8 committed cache,
         # _write_kv quantizes the accepted vectors here — the same
         # quantizer the incremental path applies, so a token's cache entry
-        # is bit-identical whichever path wrote it.
+        # is bit-identical whichever path wrote it.  The spec-buffer READ
+        # stays slot-contiguous (sk/sv are never paged); only the committed
+        # destination translates through the block table.
         src = bc.commit_src_spec_index
         dst = bc.commit_dst_position
         new_state = dict(state)
@@ -726,6 +808,7 @@ class IncMultiHeadSelfAttention(Op):
             state, rows, dst,
             self._gather_rows_pos(sk, rows, src),
             self._gather_rows_pos(sv, rows, src),
+            pages,
         ))
         return new_state
 
@@ -740,6 +823,7 @@ class IncMultiHeadSelfAttention(Op):
         kc, vc, sk, sv = state["k"], state["v"], state["sk"], state["sv"]
         nreq = kc.shape[0] - 1
         rows = self._rows(base, nreq)
+        pages = ctx.extras.get("pages") if ctx is not None else None
         spec_idx = jnp.clip(bc.spec_index, 0, sk.shape[2] - 1)
         sk = self._scatter_rows_pos(sk, rows, spec_idx, k)
         sv = self._scatter_rows_pos(sv, rows, spec_idx, v)
@@ -770,11 +854,15 @@ class IncMultiHeadSelfAttention(Op):
             layout = ctx.extras.get("tree_layout")
             kv_q = kc.dtype == jnp.int8
             scales = (state["k_scale"], state["v_scale"]) if kv_q else ()
+            pg = (pages.table,) if pages is not None else ()
+            pg_size = pages.page_size if pages is not None else 0
 
             def attend(q_, kc_, vc_, sk_, sv_, rows_, clens_, amask_,
-                       *scales_):
+                       *rest):
                 kv_l, gq = q_.shape[1], q_.shape[2]
                 d = self.head_dim
+                scales_ = rest[:len(scales)]
+                pt_ = rest[len(scales)] if pg else None
                 ks_ = scales_[0] if scales_ else None
                 vs_ = scales_[1] if scales_ else None
                 if layout:
@@ -788,6 +876,7 @@ class IncMultiHeadSelfAttention(Op):
                         amask_[:used].reshape(r_t, p_t, -1),
                         scale=self.scaling_factor, interpret=interp,
                         k_scale=ks_, v_scale=vs_,
+                        page_table=pt_, page_size=pg_size,
                     ).reshape(used, kv_l * gq, d)
                     if used < t:  # capacity-pad tokens: outputs are ignored
                         ob = jnp.zeros((t, kv_l * gq, d), ob.dtype) \
@@ -798,30 +887,37 @@ class IncMultiHeadSelfAttention(Op):
                     kc_, vc_, sk_, sv_, rows_, clens_, amask_,
                     scale=self.scaling_factor, interpret=interp,
                     k_scale=ks_, v_scale=vs_,
+                    page_table=pt_, page_size=pg_size,
                 ).reshape(t, kv_l, gq, d)
 
             h = self._config_head_axes(ctx)
             sm = self._head_shard_map(
                 ctx, h,
                 [P(None, h)] * 5 + [P(), P(), P()]
-                + [P(None, h)] * len(scales),
+                + [P(None, h)] * len(scales) + [P()] * len(pg),
                 P(None, h),
             )
             if sm is not None:
                 out = sm(attend)(q, kc, vc, sk, sv, rows, clens, amask,
-                                 *scales)
+                                 *scales, *pg)
                 out = out.reshape(t, self.num_q_heads, self.head_dim)
                 new_state = dict(state)
                 new_state["sk"], new_state["sv"] = sk, sv
                 return out, new_state
 
-        k_cache_tok = kc[rows]   # [T, KV, S, D]
-        v_cache_tok = vc[rows]
+        if pages is not None:  # logical reconstruction of committed rows
+            k_cache_tok = _gather_logical_rows(kc, pages, rows)
+            v_cache_tok = _gather_logical_rows(vc, pages, rows)
+        else:
+            k_cache_tok = kc[rows]   # [T, KV, S, D]
+            v_cache_tok = vc[rows]
         if kc.dtype == jnp.int8:  # dequant (Pallas path fuses this instead)
-            k_cache_tok = self._dequant_rows(
-                k_cache_tok, state["k_scale"], rows, q.dtype)
-            v_cache_tok = self._dequant_rows(
-                v_cache_tok, state["v_scale"], rows, q.dtype)
+            ks_tok = (_gather_logical_rows(state["k_scale"], pages, rows)
+                      if pages is not None else state["k_scale"][rows])
+            vs_tok = (_gather_logical_rows(state["v_scale"], pages, rows)
+                      if pages is not None else state["v_scale"][rows])
+            k_cache_tok = self._dequant_rows(k_cache_tok, ks_tok, q.dtype)
+            v_cache_tok = self._dequant_rows(v_cache_tok, vs_tok, q.dtype)
         k_spec_tok = sk[rows]    # [T, KV, P, D]
         v_spec_tok = sv[rows]
         s = k_cache_tok.shape[2]

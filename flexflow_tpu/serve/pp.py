@@ -237,6 +237,7 @@ class PipelinedInferenceManager:
         kv_dtype: Optional[str] = None,
         gate_lm_head: bool = True,
         topk: int = 0,
+        kv_page_size: Optional[int] = None,
     ):
         from ..parallel.mesh import make_mesh
 
@@ -325,7 +326,19 @@ class PipelinedInferenceManager:
         ]
         for stage, skv in zip(self.stages, stage_kvs):
             stage.kv = skv
-        self.kv = KVAllocator(stage_kvs, max_requests, max_seq_len)
+        # paged KV under pp: every stage's buffers share one ROW x SEQ
+        # geometry, so ONE logical block table addresses all the per-stage
+        # page pools simultaneously — a page id names the same (row,
+        # seq-range) in every stage's k/v (+ scale) planes, and a COW copy
+        # runs across all of them (kv_paged._copy_page iterates stages).
+        self.kv_page_size = kv_page_size
+        if kv_page_size:
+            from .kv_paged import PagedKVAllocator
+
+            self.kv = PagedKVAllocator(stage_kvs, max_requests, max_seq_len,
+                                       page_size=kv_page_size)
+        else:
+            self.kv = KVAllocator(stage_kvs, max_requests, max_seq_len)
 
         backend = jax.default_backend()
         self.use_pallas = (backend == "tpu") if use_pallas == "auto" \
@@ -333,6 +346,10 @@ class PipelinedInferenceManager:
         self.pallas_interpret = backend != "tpu"
         self.prefill_tile = pick_prefill_tile(max_tokens_per_batch,
                                               max_seq_len)
+        if kv_page_size:
+            from .kv_paged import validate_page_tile
+
+            validate_page_tile(kv_page_size, self.prefill_tile)
         self.tree_token_layout = None
         self.prefill_overlap = False  # single-program lever; N/A here
 
@@ -388,7 +405,7 @@ class PipelinedInferenceManager:
         entry = tuple(stage.entry_tids)
         token_tid = self._token_tid
 
-        def impl(params, state, bc, xs, sample=None):
+        def impl(params, state, bc, xs, sample=None, pages=None):
             base = bc if isinstance(bc, BatchConfig) else bc.base
             if entry == (token_tid,):
                 inputs = {token_tid: base.tokens}
@@ -402,6 +419,7 @@ class PipelinedInferenceManager:
                     "pallas_interpret": self.pallas_interpret,
                     "tree_layout": None,
                     "qkv0": None,
+                    "pages": pages,
                 },
             )
             if not last:
@@ -509,7 +527,12 @@ class PipelinedInferenceManager:
             return bc.split_microbatches(self.n_micro)
         return [bc]  # prefill chunks / tree batches ride whole
 
-    def _dispatch(self, bc, sample=None, mb: int = 0):
+    def _page_view(self):
+        """Device-side block table (None = slot-contiguous); ONE logical
+        table shared by every stage's page pool."""
+        return self.kv.page_view()
+
+    def _dispatch(self, bc, sample=None, mb: int = 0, pages=None):
         """One micro-batch through the stage chain; returns the last
         stage's InferenceResult (device arrays, not synced).
 
@@ -529,6 +552,8 @@ class PipelinedInferenceManager:
                 if fi is not None:
                     fi.maybe_fail(f"stage{s}_dispatch")
                 bc_s = jax.device_put(bc, stage.replicated)
+                pg_s = (jax.device_put(pages, stage.replicated)
+                        if pages is not None else None)
                 if s > 0:
                     if fi is not None:
                         fi.maybe_fail(f"stage{s}_hop")
@@ -540,12 +565,12 @@ class PipelinedInferenceManager:
                                for x in xs)
                 if s < n - 1:
                     xs, stage.state = stage.step(stage.params, stage.state,
-                                                 bc_s, xs)
+                                                 bc_s, xs, None, pg_s)
                 else:
                     smp = (jax.device_put(sample, stage.replicated)
                            if sample is not None else None)
                     res, stage.state = stage.step(stage.params, stage.state,
-                                                  bc_s, xs, smp)
+                                                  bc_s, xs, smp, pg_s)
         return res
 
     @staticmethod
@@ -575,6 +600,7 @@ class PipelinedInferenceManager:
             # measured stage occupancy (XProf) on device runs
             tel.metrics.gauge("pp_bubble_frac").set(
                 max(0, self.pp - len(mbs)) / self.pp)
+        pv = self._page_view()
         with tel.span("pp_macro_step", cat="pp", track="pp",
                       n_micro=len(mbs)):
             results = []
@@ -594,7 +620,7 @@ class PipelinedInferenceManager:
                         # as the single-program step, different bitstream
                         key, t, p = sample
                         smp = (jax.random.fold_in(key, j), t, p)
-                results.append(self._dispatch(mbc, smp, mb=j))
+                results.append(self._dispatch(mbc, smp, mb=j, pages=pv))
         return self._merge_results(results)
 
     # ------------------------------------------------------------------
@@ -643,6 +669,9 @@ class PipelinedInferenceManager:
         if tel.enabled:
             tel.metrics.gauge("pp_bubble_frac").set(
                 max(0, self.pp - m) / self.pp)
+        # one table fetch for the whole scan: the manager pre-mapped every
+        # page the n_steps positions can reach (no mid-scan mutation)
+        pv = self._page_view()
         for i in range(n_steps):
             with tel.span("pp_decode_macro_step", cat="pp", track="pp",
                           step=i, n_micro=m):
@@ -658,7 +687,7 @@ class PipelinedInferenceManager:
                         else:
                             key, t, p = sample
                             smp = (jax.random.fold_in(key, i * m + j), t, p)
-                    res = self._dispatch(mbs[j], smp, mb=j)
+                    res = self._dispatch(mbs[j], smp, mb=j, pages=pv)
                     mbs[j], alive[j], live = self._advance(
                         mbs[j], res.token_ids, alive[j], eos=eos)
                     toks[i][j] = res.token_ids

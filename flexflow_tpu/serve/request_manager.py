@@ -311,8 +311,13 @@ class RequestManager:
                 r for r in self._active()
                 if r.status in (RequestStatus.PREFILLING,
                                 RequestStatus.DECODING)]
-            committed = sum(self._seq_len_needed(r) for r in live) \
-                + self._seq_len_needed(req)
+            # page-granular under a paged allocator: a request can only
+            # ever hold whole pages, so its worst-case need rounds up to
+            # the page size (round_need is identity for slot-contiguous)
+            kv0 = getattr(self.im, "kv", None)
+            rnd = kv0.round_need if kv0 is not None else (lambda t: t)
+            committed = sum(rnd(self._seq_len_needed(r)) for r in live) \
+                + rnd(self._seq_len_needed(req))
             # the budget: an explicit byte cap when configured (this is
             # where the per-token BYTE pricing decides — int8 vs bf16 KV
             # admit differently under the same cap), else the headroom
@@ -689,6 +694,9 @@ class RequestManager:
         req_idx: List[int] = []
         positions: List[int] = []
         sample_points: List[Tuple[int, int]] = []
+        # cache-write spans this step will perform (rid, lo, hi) — the
+        # paged allocator maps/COWs those pages BEFORE dispatch
+        spans: List[Tuple[int, int, int]] = []
         budget = self.im.max_tokens
 
         # decode tokens first: one per DECODING request (latency-critical)
@@ -699,6 +707,7 @@ class RequestManager:
                 req_idx.append(req.slot)
                 positions.append(pos)
                 sample_points.append((len(tokens) - 1, req.rid))
+                spans.append((req.rid, pos, pos + 1))
                 budget -= 1
 
         n_decode = len(tokens)
@@ -727,6 +736,7 @@ class RequestManager:
                 segments.append(
                     (req.slot, req.prefill_tokens[start: start + take], start)
                 )
+                spans.append((req.rid, start, start + take))
                 req.prefill_offset += take
                 req.starved_steps = 0
                 budget -= -(-take // tile) * tile  # padded tiles consumed
@@ -750,6 +760,7 @@ class RequestManager:
                 (slot if gate else last_flat[slot], rid)
                 for slot, rid in sample_points
             ]
+            self._kv_prepare(spans)
             self._note_batch(0, sum(len(s[1]) for s in segments), seq_lens)
             return pbc, sample_points
 
@@ -804,6 +815,8 @@ class RequestManager:
                 tokens.append(req.prefill_tokens[start + j])
                 req_idx.append(req.slot)
                 positions.append(start + j)
+            if take:
+                spans.append((req.rid, start, start + take))
             req.prefill_offset += take
             req.starved_steps = 0
             budget -= take
@@ -823,6 +836,7 @@ class RequestManager:
             max_tokens=self.im.max_tokens,
             max_requests=self.im.max_requests,
         )
+        self._kv_prepare(spans)
         self._note_batch(n_decode, len(tokens) - n_decode, seq_lens)
         return bc, sample_points
 
@@ -863,6 +877,14 @@ class RequestManager:
         token_ids = np.asarray(result.token_ids)
         for flat_idx, rid in sample_points:
             req = self.requests[rid]
+            if req.status not in (RequestStatus.PREFILLING,
+                                  RequestStatus.DECODING):
+                # the request left its slot between batch build and result
+                # readback (page-pressure preemption in _kv_prepare runs
+                # AFTER the batch is built): its emission is dead — the
+                # readmission recomputes it, and appending here would
+                # double-count the token in the recompute feed
+                continue
             tok = int(token_ids[flat_idx])
             if req.status is RequestStatus.PREFILLING:
                 req.status = RequestStatus.DECODING
@@ -967,6 +989,14 @@ class RequestManager:
         im = self.im
         tile = im.prefill_tile
         cap = im.max_tokens
+        # the whole stretch's write spans, prepared before the first
+        # dispatch (the scans run back-to-back with no host boundary to
+        # map pages at)
+        self._kv_prepare([
+            (r.rid, r.prefill_offset, len(r.prefill_tokens))
+            for r in self._active()
+            if r.status is RequestStatus.PREFILLING
+            and r.prefill_offset < len(r.prefill_tokens)])
         gate = bool(getattr(im, "gate_lm_head", False))
         sampling = self.gen.temperature > 0.0
         n_rows = im.max_requests if gate else cap
@@ -1057,7 +1087,17 @@ class RequestManager:
 
     def _decode_stretch(self, n: int) -> None:
         """Run n decode steps on device with one host sync (decode_scan)."""
-        active = self._active()
+        # the scan writes n positions per request with no host boundary in
+        # between — map (and COW-resolve) the whole span up front, BEFORE
+        # building the batch: page-pressure preemption inside the prepare
+        # can evict a victim (slot -> -1), which must drop out of the
+        # batch instead of corrupting seq_lens via negative indexing
+        self._kv_prepare([(r.rid, r.seq_len - 1, r.seq_len - 1 + n)
+                          for r in self._active()])
+        active = [r for r in self._active()
+                  if r.status is RequestStatus.DECODING]
+        if not active:
+            return
         tokens, reqi, pos = [], [], []
         points = []
         for req in active:
@@ -1128,10 +1168,89 @@ class RequestManager:
     def _kv_bind(self, rid: int) -> None:
         """Attribution hook when a request takes a slot (overridden by
         managers holding more than one deployment's caches — the spec
-        manager binds the draft model's allocator too)."""
+        manager binds the draft model's allocator too).
+
+        Under a PAGED allocator (serve/kv_paged.py) this is also the
+        prefix-reuse hook: bind() maps every registered prefix page the
+        request's fed tokens match and returns the cached offset — the
+        prefill resumes THERE, so a shared system prompt is prefilled
+        once per fleet instead of once per request (TTFT collapses to the
+        unshared suffix).  The cached offset is tile-aligned by
+        construction (``align=prefill_tile``), preserving the tiled
+        prefill path's contract (d).
+        """
         kv = getattr(self.im, "kv", None)
-        if kv is not None:
-            kv.bind(rid)
+        if kv is None:
+            return
+        req = self.requests[rid]
+        # the tile alignment only matters when the tiled Pallas prefill
+        # path will consume the resumed offset; the flat gather path
+        # accepts any start, so it keeps every matched token
+        align = (getattr(self.im, "prefill_tile", 1)
+                 if getattr(self.im, "use_pallas", False) else 1)
+        info = kv.bind(rid, slot=req.slot, tokens=req.prefill_tokens,
+                       need=self._seq_len_needed(req), align=align)
+        if info is None:
+            return
+        cached = int(info.get("cached_tokens", 0))
+        if cached:
+            req.prefill_offset = cached
+        tel = self.telemetry
+        if tel.enabled:
+            if cached:
+                tel.prefix_cache_hit(req.trace_id, tokens_reused=cached,
+                                     pages=int(info.get("hit_pages", 0)))
+            else:
+                tel.prefix_cache_miss(req.trace_id)
+
+    def _kv_prepare(self, spans, kv=None) -> None:
+        """Pre-dispatch page preparation for every (rid, lo, hi) cache
+        write span the next dispatch will perform: the paged allocator
+        maps missing pages and copy-on-writes shared ones HERE, so the
+        block table is constant while the device works.  No-op for the
+        slot-contiguous allocator.
+
+        Pool exhaustion degrades like slot pressure does: with
+        ``res.preemption`` on, the lowest-priority decoding victim is
+        preempted — releasing its pages page-granularly — and the span
+        retries; otherwise the exhaustion propagates (an admission gate
+        sized with ``round_need`` prevents reaching it).
+        """
+        kv = kv if kv is not None else getattr(self.im, "kv", None)
+        if kv is None or not getattr(kv, "paged", False) or not spans:
+            return
+        from .kv_paged import PagePoolExhausted
+
+        for rid, lo, hi in spans:
+            for _ in range(len(self.slots) + 1):
+                try:
+                    kv.prepare_write(rid, lo, hi)
+                    break
+                except PagePoolExhausted:
+                    victim = self._page_pressure_victim(rid)
+                    if victim is None:
+                        raise
+                    self.preempt(victim.rid)
+
+    def _page_pressure_victim(self, needer_rid: int):
+        """Lowest-priority DECODING request (newest first among equals,
+        bounded by max_preemptions) whose priority is STRICTLY below the
+        needer's — the same invariant the slot-pressure path enforces
+        ("preemption only ever evicts strictly-lower priority"; a page
+        shortfall must not priority-invert).  None when preemption is off
+        or nothing admissible is evictable — the exhaustion then
+        propagates."""
+        if not self.res.preemption:
+            return None
+        need_pri = self.requests[needer_rid].priority
+        victims = [r for r in self._active()
+                   if r.status is RequestStatus.DECODING
+                   and r.rid != needer_rid
+                   and r.priority < need_pri
+                   and r.preemptions < self.res.max_preemptions]
+        if not victims:
+            return None
+        return min(victims, key=lambda r: (r.priority, -r.rid))
 
     def kv_snapshot(self) -> Optional[Dict]:
         """The deployment's live KV view (pure read — see

@@ -161,10 +161,15 @@ class SpecInferManager(RequestManager):
     # memory observability over TWO deployments (target + draft)
     # ------------------------------------------------------------------
     def _kv_bind(self, rid: int) -> None:
+        # the target allocator gets the full prefix-reuse bind (the LLM
+        # prompt prefill consumes the cached offset); the draft cache
+        # binds attribution + slot only — its pages map on demand through
+        # the ssm-side prepare spans, no prefix chain (the catch-up feed
+        # is committed-depth-driven, not offset-driven)
         super()._kv_bind(rid)
         kv_s = getattr(self.ssm, "kv", None)
         if kv_s is not None:
-            kv_s.bind(rid)
+            kv_s.bind(rid, slot=self.requests[rid].slot)
 
     def _release_slot(self, req: Request) -> None:
         if req.slot < 0:
@@ -243,7 +248,7 @@ class SpecInferManager(RequestManager):
         self._admit()
         # LLM prefill for new requests (chunked by the LLM token budget)
         while True:
-            toks, reqi, pos, points = [], [], [], []
+            toks, reqi, pos, points, spans = [], [], [], [], []
             budget = self.llm.max_tokens
             for req in self._active():
                 if req.status is not RequestStatus.PREFILLING or budget <= 0:
@@ -253,12 +258,15 @@ class SpecInferManager(RequestManager):
                 toks += req.prompt[st : st + take]
                 reqi += [req.slot] * take
                 pos += list(range(st, st + take))
+                if take:
+                    spans.append((req.rid, st, st + take))
                 req.prefill_offset += take
                 budget -= take
                 if req.prefill_offset == len(req.prompt):
                     points.append((len(toks) - 1, req.rid))
             if not toks:
                 break
+            self._kv_prepare(spans)
             bc = self._plain_bc(self.llm, toks, reqi, pos)
             # sample arg so the first generated token (read off the last
             # prompt position's logits) honors temperature/top_p.  All
@@ -283,11 +291,12 @@ class SpecInferManager(RequestManager):
 
         # SSM prefill (prompt) + catch-up (tokens accepted by previous rounds)
         while True:
-            toks, reqi, pos = [], [], []
+            toks, reqi, pos, spans = [], [], [], []
             budget = self.ssm.max_tokens
             for req in self._active():
                 if budget <= 0:
                     break
+                lo = len(pos)
                 if req.ssm_committed < len(req.prompt):
                     take = min(budget, len(req.prompt) - req.ssm_committed)
                     st = req.ssm_committed
@@ -305,8 +314,12 @@ class SpecInferManager(RequestManager):
                     req.ssm_backlog = req.ssm_backlog[take:]
                     req.ssm_committed += take
                     budget -= take
+                if len(pos) > lo:
+                    spans.append((req.rid, min(pos[lo:]),
+                                  max(pos[lo:]) + 1))
             if not toks:
                 break
+            self._kv_prepare(spans, kv=getattr(self.ssm, "kv", None))
             bc = self._plain_bc(self.ssm, toks, reqi, pos)
             if self._guarded("spec_ssm_prefill",
                              lambda b=bc: self.ssm.step(b)) is None:
@@ -435,7 +448,7 @@ class SpecInferManager(RequestManager):
         P = self.llm.max_spec_tokens
         masks = np.zeros((R, P, P), bool)
         toks, reqi, pos, spec, index_of = [], [], [], [], {}
-        commit = []
+        commit, spans = [], []
         for req in drafting:
             for ni, node in enumerate(req.tree):
                 masks[req.slot, ni, ni] = True
@@ -449,7 +462,14 @@ class SpecInferManager(RequestManager):
                 spec.append(ni)
             for src, dst in req.pending_commit:
                 commit.append((req.slot, src, dst))
+            if req.pending_commit:
+                # the commit descriptor writes accepted KV into the
+                # committed cache at these positions (the spec-tree buffer
+                # itself is never paged)
+                dsts = [d for _, d in req.pending_commit]
+                spans.append((req.rid, min(dsts), max(dsts) + 1))
             req.pending_commit = []
+        self._kv_prepare(spans)
         bc = self._tree_bc(
             TreeVerifyBatchConfig, self.llm, toks, reqi, pos, spec, masks,
             committed_attr="llm_committed", commit=commit,

@@ -183,8 +183,25 @@ class SpecDecodeScan:
                 f"n_macro={n_macro} could reach position {worst} > "
                 f"SSM max_seq_len {self.ssm.max_seq_len}"
             )
+        # paged KV: committed depths advance ON DEVICE inside the scan, so
+        # every page a slot's worst-case growth can reach is mapped (and
+        # COW-resolved) up front — the block table is then constant for
+        # the whole scan (slot-addressed: the scan has no rids)
+        grow = n_macro * (self.depth + 1) + self.depth
+        for im, comm_key in ((self.llm, "llm_comm"), (self.ssm, "ssm_comm")):
+            kv = getattr(im, "kv", None)
+            if not getattr(kv, "paged", False):
+                continue
+            comm = np.asarray(carry[comm_key])
+            fin = np.asarray(carry["finished"])
+            for r in range(im.max_requests):
+                if not fin[r]:
+                    kv.prepare_slot_span(
+                        r, int(comm[r]),
+                        min(int(comm[r]) + grow, im.max_seq_len))
         emitted, carry = self._scan(
-            self.llm.params, self.ssm.params, carry, sample, n_macro=n_macro
+            self.llm.params, self.ssm.params, carry, sample,
+            self.llm._page_view(), self.ssm._page_view(), n_macro=n_macro
         )
         # keep the managers' views of their caches current
         self.llm.state = carry["llm_state"]
@@ -193,19 +210,21 @@ class SpecDecodeScan:
 
     # ------------------------------------------------------------------
     def _scan_impl(self, llm_params, ssm_params, carry, sample,
-                   n_macro: int):
+                   llm_pages, ssm_pages, n_macro: int):
         def body(c, _):
             stp = None
             if sample is not None:
                 key, temperature, top_p = sample
                 stp = (jax.random.fold_in(key, c["macro_ctr"]),
                        temperature, top_p)
-            return self._macro_body(llm_params, ssm_params, c, stp)
+            return self._macro_body(llm_params, ssm_params, c, stp,
+                                    llm_pages, ssm_pages)
 
         carry, emitted = jax.lax.scan(body, carry, None, length=n_macro)
         return emitted, carry
 
-    def _macro_body(self, llm_params, ssm_params, c, sample=None):
+    def _macro_body(self, llm_params, ssm_params, c, sample=None,
+                    llm_pages=None, ssm_pages=None):
         R, W, D, P = (self.llm.max_requests, self.width, self.depth,
                       self.n_tree)
         fin = c["finished"]
@@ -230,7 +249,8 @@ class SpecDecodeScan:
             num_tokens=jnp.sum(valid),
             seq_lens=c["ssm_comm"] + nb,
         )
-        _, ssm_state = self.ssm._step_impl(ssm_params, c["ssm_state"], bc_cu)
+        _, ssm_state = self.ssm._step_impl(ssm_params, c["ssm_state"], bc_cu,
+                                           pages=ssm_pages)
         ssm_comm = c["ssm_comm"] + nb
 
         # ---- 2. draft: unrolled beam levels (static node indices) ----
@@ -263,7 +283,8 @@ class SpecDecodeScan:
                 ancestor_mask=self._pad_mask(amask, Pb_s),
                 committed_lens=ssm_comm,
             )
-            res, ssm_state = self.ssm._step_impl(ssm_params, ssm_state, bc_d)
+            res, ssm_state = self.ssm._step_impl(ssm_params, ssm_state, bc_d,
+                                                 pages=ssm_pages)
             k_ids = res.topk_ids[: R * F].reshape(R, F, -1)[:, :, :W]
             k_lp = res.topk_logprobs[: R * F].reshape(R, F, -1)[:, :, :W]
             cand_lp = (cumlp[:, f_idx][:, :, None] + k_lp).reshape(R, F * W)
@@ -321,7 +342,8 @@ class SpecDecodeScan:
         # distributions needed at verify time, and the same walk serves both
         # modes; T→0 recovers the greedy walk exactly).
         res_v, llm_state = self.llm._step_impl(
-            llm_params, c["llm_state"], bc_v, sample, tree_layout=(R, P))
+            llm_params, c["llm_state"], bc_v, sample, tree_layout=(R, P),
+            pages=llm_pages)
         ids2 = res_v.token_ids[: R * P].reshape(R, P)              # [R, P]
 
         # ---- 4. accept walk (greedy or against the sampled tokens) ----

@@ -1,0 +1,566 @@
+"""Paged KV cache with copy-on-write prefix sharing (ISSUE 9).
+
+The load-bearing contracts:
+
+* **bit-identity** — the paged path (block-table indirection in the
+  Pallas kernels AND the gather fallback, translated writes, COW, prefix
+  reuse) serves tokens, logits, and LOGICAL cache contents bit-identical
+  to the slot-contiguous path, across step / generate / arrivals / pp2 /
+  int8 / spec;
+* **no-leak refcounts** — every r9 terminal outcome (ok / REJECTED /
+  CANCELLED / TIMED_OUT / PREEMPTED / FAILED) returns the request's pages
+  to the pool (request refcounts to zero); only index-held shareable
+  pages persist, and those evict under pool pressure;
+* **prefix sharing** — N requests with one system prompt prefill it
+  once: later binds hit the registered pages and resume at the cached
+  offset, and a COW copy fires when a shared request diverges mid-decode;
+* **construction-time geometry asserts** — page size must divide
+  max_seq_len, its 128-lane pad, and be a multiple of the prefill tile
+  (the r6 prefill_tile divisibility fix's sibling).
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import NULL_TELEMETRY, Telemetry
+from flexflow_tpu.serve import (
+    GenerationConfig,
+    PagedKVAllocator,
+    PagePoolExhausted,
+    RequestManager,
+    RequestStatus,
+    ResilienceConfig,
+    SpecInferManager,
+)
+from flexflow_tpu.serve.batch_config import BatchConfig
+
+from test_resilience import TriggerClock, quiet
+from test_serve import TINY, make_im
+from test_serving_under_load import VirtualClock, poisson_arrivals
+
+pytestmark = pytest.mark.paged
+
+PROMPTS = [[3, 5, 7, 9, 11], [2, 4], [13, 6, 1]]
+
+
+def _vclock_tel():
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    return Telemetry(clock=Clock())
+
+
+def _logical_rows(kv, slot, depth):
+    """One slot's logical cache rows via the paged allocator's table."""
+    return kv.logical_state(slot, depth)
+
+
+def _assert_logical_equal(contig_state, paged_kv, slots_depths):
+    """Per-slot logical cache equality: contiguous row prefix vs the
+    paged reconstruction (positions beyond each request's depth are
+    unmapped/junk by design and excluded)."""
+    for slot, depth in slots_depths:
+        got = paged_kv.logical_state(slot, depth)
+        for node, bufs in got.items():
+            for name, arr in bufs.items():
+                want = np.asarray(contig_state[node][name])[slot, :, :depth]
+                assert np.array_equal(arr, want), \
+                    f"{node}.{name} slot {slot} diverged under paging"
+
+
+# ---------------------------------------------------------------------------
+# construction-time geometry asserts (satellite: fail at allocator
+# construction, not inside the kernel grid)
+# ---------------------------------------------------------------------------
+def test_page_size_must_divide_max_seq_len_and_lane_pad():
+    from flexflow_tpu.serve.kv_allocator import StageKV
+
+    with pytest.raises(ValueError, match="divide max_seq_len"):
+        PagedKVAllocator([], max_requests=2, max_seq_len=96, page_size=64)
+    # 48 divides max_seq_len 96 but NOT the 128-lane pad
+    with pytest.raises(ValueError, match="128-lane"):
+        PagedKVAllocator([], max_requests=2, max_seq_len=96, page_size=48)
+    with pytest.raises(ValueError, match="positive"):
+        PagedKVAllocator([], max_requests=2, max_seq_len=96, page_size=0)
+    # 32 divides both 96 and 128: constructs
+    kv = PagedKVAllocator([], max_requests=2, max_seq_len=96, page_size=32)
+    assert kv.pages_per_row == 4 and kv.n_pages == 12
+
+
+def test_page_size_must_be_tile_multiple():
+    with pytest.raises(ValueError, match="prefill tile"):
+        # max_tokens=16, max_seq=64 -> tile 16; page 8 straddles tiles
+        make_im(max_tokens=16, max_requests=2, max_seq=64, kv_page_size=8)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: tokens, logits, LOGICAL caches
+# ---------------------------------------------------------------------------
+def test_single_step_bit_identical_with_logical_cache():
+    seq = np.zeros(2, np.int32)
+    seq[0] = 3
+    bc = lambda im: BatchConfig.build(  # noqa: E731
+        [3, 5, 7], [0, 0, 0], [0, 1, 2], seq,
+        max_tokens=im.max_tokens, max_requests=im.max_requests)
+
+    im = make_im(max_seq=64)
+    # direct im.step bypasses the RequestManager that would re-sync these
+    # hooks — a chaos test's leftover injector on the cached im must not
+    # perturb this test (the cached-im pool contract)
+    im.fault_injector = None
+    r0 = im.step(bc(im))
+    want_tok = np.asarray(r0.token_ids).copy()
+    want_lg = np.asarray(r0.logits_max).copy()
+    want_state = {n: {b: np.asarray(a).copy() for b, a in bufs.items()}
+                  for n, bufs in im.state.items()}
+
+    imp = make_im(max_seq=64, kv_page_size=16)
+    imp.fault_injector = None
+    imp.kv.bind(0, slot=0, tokens=[3, 5, 7], need=8)
+    imp.kv.prepare_write(0, 0, 3)
+    r1 = imp.step(bc(imp))
+    np.testing.assert_array_equal(np.asarray(r1.token_ids), want_tok)
+    np.testing.assert_array_equal(np.asarray(r1.logits_max), want_lg)
+    _assert_logical_equal(want_state, imp.kv, [(0, 3)])
+    imp.kv.release(0)
+    assert imp.kv.pages_held() == 0
+
+
+# the pallas variants use a 14-token lead prompt: its prefill crosses the
+# 16-position page boundary through the tiled-prefill write path, and the
+# 6 decode steps cross it INSIDE the on-device decode scan (positions
+# 14..19; the whole span is pre-mapped, the table constant across the
+# scan) — page-crossing coverage without extra scan-length compiles
+PROMPTS_X = [[3, 5, 7, 9, 11, 2, 4, 6, 13, 6, 1, 9, 3, 8], [2, 4],
+             [13, 6, 1]]
+
+
+@pytest.mark.parametrize("kw,prompts", [
+    (dict(max_seq=64), PROMPTS),                                # gather
+    (dict(max_tokens=8, max_requests=2, max_seq=32,
+          use_pallas=True), PROMPTS_X),                         # kernels
+    (dict(max_tokens=8, max_requests=2, max_seq=32,
+          use_pallas=True, kv_dtype="int8"), PROMPTS_X),        # int8 fused
+], ids=["gather", "pallas", "pallas-int8"])
+def test_generate_bit_identical_paged_vs_contiguous(kw, prompts):
+    im = make_im(**kw)
+    want = RequestManager(im, GenerationConfig(
+        max_new_tokens=6)).generate(prompts)
+    imp = make_im(**kw, kv_page_size=16)
+    tel = _vclock_tel()
+    rm = RequestManager(imp, GenerationConfig(max_new_tokens=6),
+                        telemetry=tel)
+    try:
+        got = rm.generate(prompts)
+    finally:
+        imp.telemetry = NULL_TELEMETRY
+    assert got == want, "paged path changed served tokens"
+    # every page returned to the pool; attribution complete
+    assert imp.kv.pages_held() == 0
+    assert imp.kv.attributed_rids() == []
+    # the paged gauges rode kv_usage
+    snap = tel.metrics.snapshot()
+    assert snap["kv_pages_live"] >= 0
+    assert 0.0 <= snap["kv_fragmentation_frac"] <= 1.0
+
+
+def test_arrivals_bit_identical_and_no_leak():
+    rng = np.random.RandomState(7)
+    arrivals = poisson_arrivals(rng, 5, rate_per_s=30.0,
+                                vocab=TINY.vocab_size, max_new=4)
+    im = make_im(max_seq=64, max_requests=2)
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=4))
+    recs0 = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    want = [recs0[rid]["tokens"] for rid in sorted(recs0)]
+
+    imp = make_im(max_seq=64, max_requests=2, kv_page_size=16)
+    rmp = RequestManager(imp, GenerationConfig(max_new_tokens=4))
+    recs1 = rmp.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert [recs1[rid]["tokens"] for rid in sorted(recs1)] == want
+    assert imp.kv.pages_held() == 0
+    assert imp.kv.attributed_rids() == []
+
+
+def test_pp2_paged_bit_identical():
+    from test_pp_serve import make_pp_im
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6]]
+    pim = make_pp_im({"pp": 2})
+    want = RequestManager(pim, GenerationConfig(max_new_tokens=5)).generate(
+        prompts)
+    pimp = make_pp_im({"pp": 2}, kv_page_size=16)
+    got = RequestManager(pimp, GenerationConfig(max_new_tokens=5)).generate(
+        prompts)
+    assert got == want
+    # one logical table over per-stage pools
+    assert isinstance(pimp.kv, PagedKVAllocator)
+    assert len(pimp.kv.stages) == 2
+    assert pimp.kv.pages_held() == 0
+
+
+def test_spec_paged_bit_identical():
+    from test_spec_infer import TINY_SSM
+
+    kw = dict(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    llm = make_im(**kw)
+    ssm = make_im(**kw, cfg=TINY_SSM, topk=2, seed=123)
+    want = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                            width=2, depth=3).generate(prompts)
+    llm_p = make_im(**kw, kv_page_size=32)
+    ssm_p = make_im(**kw, cfg=TINY_SSM, topk=2, seed=123, kv_page_size=32)
+    got = SpecInferManager(llm_p, ssm_p, GenerationConfig(max_new_tokens=8),
+                           width=2, depth=3).generate(prompts)
+    assert got == want
+    assert llm_p.kv.pages_held() == 0
+    assert ssm_p.kv.pages_held() == 0
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing + copy-on-write
+# ---------------------------------------------------------------------------
+def test_prefix_reuse_skips_prefill_across_sessions():
+    # wave 1 registers the prompt's pages; wave 2 (fresh manager, same
+    # buffers — reset_attribution keeps the index) resumes prefill at the
+    # cached offset with identical outputs
+    prompt = list(range(1, 21))  # 20 tokens, page 16 -> 1 full + tail
+    imp = make_im(max_seq=64, max_requests=2, kv_page_size=16)
+    rm1 = RequestManager(imp, GenerationConfig(max_new_tokens=5))
+    want = rm1.generate([prompt])
+    hits0 = imp.kv.prefix_hits
+    rm2 = RequestManager(imp, GenerationConfig(max_new_tokens=5))
+    got = rm2.generate([list(prompt)])
+    assert got == want
+    assert imp.kv.prefix_hits > hits0, "second session never hit the index"
+    # the resumed request fed only the unshared remainder
+    req = rm2.requests[0]
+    assert req.prefill_offset == len(prompt)  # completed
+    assert imp.kv.prefix_tokens_reused > 0
+
+
+def test_cow_on_shared_divergence_mid_decode_bit_identical():
+    # A starts; B with the SAME prompt arrives while A decodes.  B's bind
+    # maps A's registered pages (incl. the partial tail), so A's next
+    # decode write finds a second holder and copies the page — divergence
+    # lands on a private copy, with outputs bit-identical to the
+    # contiguous run for BOTH requests.
+    prompt = [3, 5, 7, 9, 11, 2, 4, 6, 13, 6, 1, 9, 3, 3, 5, 8, 7, 2]
+    arrivals = [(0.0, prompt, 20), (0.1, list(prompt), 20)]
+
+    im = make_im(max_seq=64, max_requests=2)
+    rm = RequestManager(im, GenerationConfig(max_new_tokens=20))
+    recs = rm.serve_with_arrivals([(t, list(p), m) for t, p, m in arrivals],
+                                  clock=VirtualClock())
+    want = [recs[r]["tokens"] for r in sorted(recs)]
+    assert want[0] == want[1]  # same prompt, greedy -> same continuation
+
+    imp = make_im(max_seq=64, max_requests=2, kv_page_size=16)
+    hits0, cow0 = imp.kv.prefix_hits, imp.kv.cow_copies
+    rmp = RequestManager(imp, GenerationConfig(max_new_tokens=20))
+    recsp = rmp.serve_with_arrivals(
+        [(t, list(p), m) for t, p, m in arrivals], clock=VirtualClock())
+    got = [recsp[r]["tokens"] for r in sorted(recsp)]
+    assert got == want, "COW/sharing changed served tokens"
+    assert imp.kv.prefix_hits > hits0, "B never hit A's pages"
+    assert imp.kv.cow_copies > cow0, "no COW fired on divergence"
+    assert imp.kv.pages_held() == 0
+
+
+def test_sole_holder_divergence_cannot_corrupt_the_index():
+    # review-hardening regression: B maps A's registered tail page on a
+    # SHORTER match (their tokens diverge INSIDE the protected range) and
+    # is the page's only request holder — its write must still COW, or
+    # the index would serve B's divergent KV to a later full-match bind.
+    # ps=16: A's prompt is 1 full page + a 4-token tail; B shares only 2
+    # tail tokens; C repeats A exactly and must see A's untouched pages.
+    base = list(range(1, 17))
+    prompt_a = base + [101, 102, 103, 104]
+    prompt_b = base + [101, 102, 999, 998]
+    prompt_c = list(prompt_a)
+
+    # contiguous oracle, served sequentially (no sharing possible)
+    im = make_im(max_seq=64, max_requests=2)
+    gen = GenerationConfig(max_new_tokens=5)
+    want = [RequestManager(im, gen).generate([p])[0]
+            for p in (prompt_a, prompt_b, prompt_c)]
+
+    imp = make_im(max_seq=64, max_requests=2, kv_page_size=16)
+    cow0 = imp.kv.cow_copies
+    got = [RequestManager(imp, gen).generate([list(p)])[0]
+           for p in (prompt_a, prompt_b, prompt_c)]
+    assert got == want, "divergent sharer corrupted an index-held page"
+    # B's divergent write inside A's protected tail range forced a copy
+    # even though B was the page's only request holder
+    assert imp.kv.cow_copies > cow0
+    assert imp.kv.pages_held() == 0
+
+
+def test_preempted_readmission_reuses_its_own_pages():
+    # preemption releases pages page-granularly; the readmission's bind
+    # prefix-hits the request's own registered pages, so the recompute
+    # prefill collapses too — with the r9 bit-identity contract intact
+    from test_resilience import _serve_with_midway_preempt
+
+    prompt_a = list(range(1, 21))
+    im = make_im(max_seq=64)
+    gen = GenerationConfig(max_new_tokens=10)
+    _, rec0 = _serve_with_midway_preempt(im, gen, [prompt_a, [2, 4, 6, 8]],
+                                         preempt_rid=0)
+    want = [rec0[r]["tokens"] for r in sorted(rec0)]
+
+    imp = make_im(max_seq=64, kv_page_size=16)
+    hits0 = imp.kv.prefix_hits
+    rmp, rec1 = _serve_with_midway_preempt(imp, gen,
+                                           [list(prompt_a), [2, 4, 6, 8]],
+                                           preempt_rid=0)
+    assert [rec1[r]["tokens"] for r in sorted(rec1)] == want
+    assert rmp.requests[0].preemptions == 1
+    assert imp.kv.prefix_hits > hits0, \
+        "readmission should hit the request's own registered pages"
+    assert imp.kv.pages_held() == 0
+
+
+# ---------------------------------------------------------------------------
+# refcount no-leak across every r9 terminal outcome
+# ---------------------------------------------------------------------------
+def _assert_pool_clean(kv):
+    assert kv.pages_held() == 0, "request-held pages leaked"
+    assert kv.attributed_rids() == []
+    assert int(kv._req_refs.sum()) == 0
+    # every non-free page is exactly an index-held shareable page
+    snap = kv.snapshot()
+    assert snap["pages_free"] + snap["pages_indexed"] == snap["pages_total"]
+
+
+def test_no_leak_ok_and_rejected():
+    imp = make_im(max_seq=64, kv_page_size=16)
+    rm = RequestManager(imp, GenerationConfig(max_new_tokens=4),
+                        resilience=ResilienceConfig(max_pending=2))
+    rm.generate([[3, 5, 7], [2, 4, 6], [11, 13], [9, 8, 1]])
+    statuses = {r.status for r in rm.requests.values()}
+    assert RequestStatus.REJECTED in statuses
+    assert RequestStatus.COMPLETED in statuses
+    _assert_pool_clean(imp.kv)
+
+
+def test_no_leak_cancelled_mid_serve():
+    imp = make_im(max_seq=64, kv_page_size=16)
+    rm = quiet(RequestManager(imp, GenerationConfig(max_new_tokens=12)))
+    rm.scan_chunk = 2
+    arrivals = [(0.0, [3, 11, 25, 40, 7], 12), (0.0, [2, 4, 6, 8], 12)]
+    clock = TriggerClock(
+        ready=lambda: 1 in rm.requests
+        and 2 <= len(rm.requests[1].generated) < 11,
+        fn=lambda: rm.cancel(1))
+    records = rm.serve_with_arrivals(arrivals, clock=clock)
+    assert clock.fired and records[1]["outcome"] == "cancelled"
+    _assert_pool_clean(imp.kv)
+
+
+def test_no_leak_timeout():
+    imp = make_im(max_seq=64, kv_page_size=16)
+    rm = quiet(RequestManager(imp, GenerationConfig(max_new_tokens=8)))
+    arrivals = [
+        (0.0, [3, 11, 25, 40, 7], 8),
+        (0.0, [2, 4, 6, 8], 8),
+        (0.0, [9, 1, 5], 8, {"ttl_s": 0.05}),
+    ]
+    records = rm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert records[2]["outcome"] == "timeout"
+    _assert_pool_clean(imp.kv)
+
+
+def test_no_leak_failed():
+    from flexflow_tpu.serve import FaultInjector, RetryPolicy
+
+    imp = make_im(max_seq=64, kv_page_size=16)
+    inj = FaultInjector(seed=0, p=1.0)
+    rm = quiet(RequestManager(
+        imp, GenerationConfig(max_new_tokens=6), fault_injector=inj,
+        resilience=ResilienceConfig(retry=RetryPolicy(max_retries=1),
+                                    on_dispatch_failure="fail")))
+    try:
+        got = rm.generate([[3, 5, 7], [2, 4]])
+    finally:
+        imp.fault_injector = None
+    assert got == [[], []]
+    assert all(r.status is RequestStatus.FAILED
+               for r in rm.requests.values())
+    _assert_pool_clean(imp.kv)
+
+
+# ---------------------------------------------------------------------------
+# pool mechanics: eviction + exhaustion
+# ---------------------------------------------------------------------------
+def test_index_pages_evict_lru_and_exhaustion_raises():
+    kv = PagedKVAllocator([], max_requests=1, max_seq_len=128,
+                          page_size=32)  # 7 usable pages
+    # two requests' worth of index entries, then drain the free pool
+    kv.bind(0, slot=0, tokens=list(range(64)), need=70)
+    kv.prepare_write(0, 0, 64)
+    kv.prepare_write(0, 64, 65)   # registers pages 0..1 (full)
+    kv.release(0)
+    # 64 tokens = exactly 2 full pages registered at the decode prepare
+    # (a page-aligned feed has no partial tail entry)
+    assert kv.snapshot()["pages_indexed"] == 2
+    free0 = kv.snapshot()["pages_free"]
+    # drain the pool: everything allocatable is handed out
+    taken = [kv._alloc_page() for _ in range(free0)]
+    assert kv.snapshot()["pages_free"] == 0
+    # next allocation evicts an index-held (request-free) page, LRU first
+    evicted_before = kv.pages_evicted
+    pid = kv._alloc_page()
+    assert kv.pages_evicted == evicted_before + 1
+    taken.append(pid)
+    # keep draining: once nothing is evictable, exhaustion raises
+    with pytest.raises(PagePoolExhausted):
+        for _ in range(kv.n_pages):
+            taken.append(kv._alloc_page())
+
+
+def test_round_need_and_capacity_are_page_granular():
+    imp = make_im(max_seq=64, max_requests=2, kv_page_size=16)
+    kv = imp.kv
+    assert kv.round_need(1) == 16
+    assert kv.round_need(16) == 16
+    assert kv.round_need(17) == 32
+    # pool capacity: every non-scratch page (the pad region's pages are
+    # real capacity — the multiplier vs the slot-contiguous R*max_seq)
+    assert kv.capacity_tokens == (kv.n_pages - 1) * 16
+    assert kv.capacity_tokens > imp.max_requests * imp.max_seq_len
+
+
+def test_fragmentation_collapses_to_intra_page_waste():
+    imp = make_im(max_seq=64, max_requests=2, kv_page_size=16)
+    kv = imp.kv
+    kv.bind(0, slot=0, tokens=[1] * 30, need=34)
+    kv.prepare_write(0, 0, 30)
+    kv.observe({0: 30})
+    snap = kv.snapshot()
+    # 30 live over 2 pages (32 reserved): waste is the 2-position tail,
+    # not the 34 idle positions of a reserved 64-slot span
+    assert snap["pages_live"] == 2
+    assert snap["fragmentation_frac"] == pytest.approx(1 - 30 / 32)
+    from flexflow_tpu.serve.kv_allocator import KVAllocator
+
+    contig = KVAllocator(kv.stages, 2, 64)
+    contig.bind(0)
+    contig.observe({0: 30})
+    assert contig.snapshot()["fragmentation_frac"] == pytest.approx(
+        1 - 30 / 64)
+    kv.release(0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel indirection: paged == contiguous with a scattered layout
+# ---------------------------------------------------------------------------
+def test_decode_kernel_paged_matches_contiguous_layout():
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.pallas.attention import decode_attention
+
+    rng = np.random.default_rng(0)
+    t, r, kvh, d, s, page = 6, 3, 2, 8, 64, 16
+    ppr = s // page
+    kc = jnp.asarray(rng.normal(size=(r + 1, kvh, s, d)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(r + 1, kvh, s, d)), jnp.float32)
+    rows = jnp.asarray([0, 1, 2, 1, 0, 3], jnp.int32)
+    pos = jnp.asarray([5, 17, 0, 18, 6, 0], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(t, 2 * kvh, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    want = decode_attention(q, kc, vc, rows, pos, scale, block_s=16,
+                            interpret=True)
+
+    # scatter the logical pages across a shuffled physical pool
+    n_pages = (r + 1) * ppr
+    perm = np.random.RandomState(3).permutation(n_pages)
+    table = np.asarray(perm, np.int32).reshape(r + 1, ppr)
+    kc_p = np.zeros_like(np.asarray(kc))
+    vc_p = np.zeros_like(np.asarray(vc))
+    for row in range(r + 1):
+        for j in range(ppr):
+            pr, psl = divmod(int(table[row, j]), ppr)
+            kc_p[pr, :, psl * page:(psl + 1) * page] = \
+                np.asarray(kc)[row, :, j * page:(j + 1) * page]
+            vc_p[pr, :, psl * page:(psl + 1) * page] = \
+                np.asarray(vc)[row, :, j * page:(j + 1) * page]
+    got = decode_attention(q, jnp.asarray(kc_p), jnp.asarray(vc_p), rows,
+                           pos, scale, block_s=16, interpret=True,
+                           page_table=jnp.asarray(table), page_size=page)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# serve-search pricing: block-granular stream + sharing discount
+# ---------------------------------------------------------------------------
+def test_search_prices_sharing_discount_and_block_rounding():
+    from flexflow_tpu.search.serve_search import _workload_knobs
+
+    feats = {"mean_prompt_len": 1000.0, "mean_output_len": 100.0,
+             "arrival_rate_per_s": 2.0, "mean_occupancy": 0.5,
+             "shared_prefix_frac": 0.75}
+    base = _workload_knobs(dict(feats, shared_prefix_frac=0.0), 2048)
+    paged = _workload_knobs(feats, 2048, kv_page_size=512)
+    # the sharing discount shrinks the prefill-side terms to the unshared
+    # share...
+    assert paged["prefill_tok_per_s"] == pytest.approx(
+        base["prefill_tok_per_s"] * 0.25)
+    assert paged["prompt_len"] == pytest.approx(base["prompt_len"] * 0.25)
+    # ...but the decode-side KV stream rounds UP to whole pages (every
+    # request still reads the shared pages for itself)
+    assert paged["kv_fill_frac"] >= base["kv_fill_frac"]
+    depth_pages = -(-(1000 + 50) // 512) * 512
+    assert paged["kv_fill_frac"] == pytest.approx(
+        min(1.0, 0.5 * depth_pages / 2048))
+    # unpaged callers ignore shared_prefix_frac entirely
+    same = _workload_knobs(feats, 2048)
+    assert same == _workload_knobs(dict(feats, shared_prefix_frac=0.0),
+                                   2048)
+
+
+def test_search_serve_plan_accepts_kv_page_size():
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.mesh import make_mesh
+    from flexflow_tpu.search.machine_model import MachineModel
+    from flexflow_tpu.search.serve_search import search_serve_plan
+    from flexflow_tpu.serve import build_model
+    from flexflow_tpu.serve.inference_manager import (
+        register_serve_capacities,
+    )
+
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff = FFModel(FFConfig(), mesh=mesh)
+    build_model(ff, TINY, max_tokens=16)
+    register_serve_capacities(ff.graph, max_requests=2, max_seq_len=2048)
+    mm = MachineModel.for_mesh(mesh, spec_name="cpu")
+    wl = {"mean_prompt_len": 1500.0, "mean_output_len": 20.0,
+          "arrival_rate_per_s": 4.0, "mean_occupancy": 1.0,
+          "shared_prefix_frac": 0.9}
+    base = search_serve_plan(ff, 1, machine=mm, workload=wl,
+                             calibration=None)
+    paged = search_serve_plan(ff, 1, machine=mm, workload=wl,
+                              calibration=None, kv_page_size=512)
+    assert paged["kv_page_size"] == 512
+    # 90% of offered prefill absorbed by the page pool: the amortized
+    # objective (tpot + ttft/out_len) strictly improves
+    assert paged["objective_ms"] < base["objective_ms"]
+    assert paged["ttft_ms"] < base["ttft_ms"]
+
+
+def test_workload_profile_tracks_shared_prefix_frac():
+    tel = _vclock_tel()
+    for i in range(3):
+        tel.prefix_cache_hit(f"r{i:05d}", tokens_reused=64)
+    tel.prefix_cache_miss("r00003")
+    feats = tel.workload.features()
+    assert feats["shared_prefix_frac"] == pytest.approx(0.75)
+    snap = tel.metrics.snapshot()
+    assert snap["prefix_hits"] == 3
+    assert snap["prefix_misses"] == 1
+    assert snap["prefix_tokens_reused"] == 192

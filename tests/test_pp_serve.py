@@ -38,10 +38,10 @@ _PIM_CACHE = {}
 
 def make_pp_im(axes=None, n_micro=None, cfg=TINY, max_tokens=16,
                max_requests=2, max_seq=32, seed=7, use_pallas=True,
-               kv_dtype=None):
+               kv_dtype=None, kv_page_size=None):
     axes = axes or {"pp": 2}
     key = (tuple(sorted(axes.items())), n_micro, repr(cfg), max_tokens,
-           max_requests, max_seq, use_pallas, kv_dtype)
+           max_requests, max_seq, use_pallas, kv_dtype, kv_page_size)
     im = _PIM_CACHE.get(key)
     if im is None:
         n = int(np.prod(list(axes.values())))
@@ -51,7 +51,7 @@ def make_pp_im(axes=None, n_micro=None, cfg=TINY, max_tokens=16,
         im = PipelinedInferenceManager(
             ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
             max_seq_len=max_seq, n_micro=n_micro, use_pallas=use_pallas,
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, kv_page_size=kv_page_size,
         )
         _PIM_CACHE[key] = im
     im.init_operators_inference(rng=jax.random.PRNGKey(seed))

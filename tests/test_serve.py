@@ -48,10 +48,11 @@ _IM_CACHE = {}
 
 def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
             max_spec=0, cfg=TINY, topk=0, seed=7, use_pallas="auto",
-            kv_dtype=None):
+            kv_dtype=None, kv_page_size=None):
     axes = mesh_axes or {"tp": 1}
     key = (tuple(sorted(axes.items())), max_tokens, max_requests, max_seq,
-           max_spec, repr(cfg), topk, seed, use_pallas, kv_dtype)
+           max_spec, repr(cfg), topk, seed, use_pallas, kv_dtype,
+           kv_page_size)
     im = _IM_CACHE.get(key)
     if im is None:
         n = int(np.prod(list(axes.values())))
@@ -62,6 +63,7 @@ def make_im(mesh_axes=None, max_tokens=16, max_requests=2, max_seq=32,
             ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
             max_seq_len=max_seq, max_spec_tokens=max_spec, topk=topk,
             use_pallas=use_pallas, kv_dtype=kv_dtype,
+            kv_page_size=kv_page_size,
         )
         _IM_CACHE[key] = im
     im.tree_token_layout = None  # allow a new SpecDecodeScan binding

@@ -179,12 +179,54 @@ def test_dry_run_memory_ledger_reconciles_and_roundtrips(dryrun):
     assert reported["memory"] == ml["summary"]
 
 
+@pytest.mark.paged
+def test_dry_run_shared_prefix_exercises_page_pool_lifecycle(dryrun):
+    """ISSUE 9 acceptance: the hermetic shared_prefix section shows the
+    shared prefix prefilled ONCE (prefix_hit = N-1 in the first wave),
+    TTFT collapsed to the unshared suffix, kv_fragmentation_frac ~ 0
+    under fill->release->refill churn, and a COW on mid-decode
+    divergence — the full page-pool lifecycle with no device."""
+    _, doc = dryrun
+    sp = doc["observability"]["shared_prefix"]
+    users = sp["users"]
+    n = len(users)
+    # the shared prefix is prefilled once: user 0 feeds the whole prompt,
+    # every later user only the unshared remainder
+    assert users[0]["cached"] == 0
+    assert all(u["cached"] == sp["shared_len"] for u in users[1:])
+    hits_wave1 = sum(1 for u in users if u["cached"] > 0)
+    assert hits_wave1 == n - 1
+    assert sp["prefix_hits"] >= n - 1  # JSONL event count (incl. churn)
+    # TTFT collapse-to-suffix: warm users pay only the suffix share
+    assert sp["ttft_collapse"] == pytest.approx(
+        sp["suffix_len"] / (sp["shared_len"] + sp["suffix_len"]), abs=1e-3)
+    assert max(sp["ttft_warm_s"]) < sp["ttft_cold_s"] / 4
+    # fragmentation: reserved-span waste (before) collapses to intra-page
+    # tail waste (after, ~0) and the churn leaves no leak
+    assert sp["fragmentation_after"] < 0.1
+    assert sp["fragmentation_after"] < sp["fragmentation_before"] / 4
+    assert sp["leak_free"]
+    # divergence mid-decode copy-on-wrote exactly once
+    assert sp["cow_on_divergence"] == 1
+    # the paged gauge vocabulary + prefix counters rode the export
+    assert sp["summary"]["paged"]["kv_pages_live"] >= 0
+    assert sp["summary"]["prefix_cache"]["prefix_hits"] == sp["prefix_hits"]
+
+    # the CLI reproduces the memory section from the JSONL alone
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         sp["paths"]["jsonl"]]))
+    assert reported["memory"] == sp["summary"]
+    assert reported["prefix_hits"] == sp["prefix_hits"]
+
+
 def test_check_mode_validates_dry_run_schema(dryrun):
     out, doc = dryrun
     script = os.path.join(REPO, "scripts", "trace_report.py")
     for jsonl in (doc["observability"]["paths"]["jsonl"],
                   doc["observability"]["feedback_loop"]["paths"]["jsonl"],
-                  doc["observability"]["memory_ledger"]["paths"]["jsonl"]):
+                  doc["observability"]["memory_ledger"]["paths"]["jsonl"],
+                  doc["observability"]["shared_prefix"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
