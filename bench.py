@@ -1726,6 +1726,114 @@ def shared_prefix_dryrun(out_dir=None, n_users=4, shared_len=64,
     }
 
 
+def spec_serving_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` speculative-serving section: the
+    acceptance-aware planning decision end to end on a virtual clock — no
+    device work (graph building + cost arithmetic; jax does shape
+    inference only).
+
+    Two traffic phases feed the REAL ``Telemetry.spec_acceptance`` API
+    (the same calls ``SpecInferManager._verify_phase`` makes per verify
+    round): a high-acceptance phase (draft tracks the target) and a
+    degraded phase (acceptance collapses below the measured break-even,
+    BENCH r05's 0.439 — now the calibratable
+    ``TPUSpec.spec_break_even_acceptance`` machine constant).
+    ``search_serve_plan(spec="auto")`` runs on each phase's live workload
+    profile: above break-even it returns a ``_spec_w{w}d{d}`` plan,
+    below it the incremental plan — the spec↔non-spec flip, visible in
+    this section's fields.  The runtime side emits ``spec_mode_changed``
+    (the per-request flip the operator would issue on the
+    recommendation) and the mixed-batch composition gauges through the
+    same real APIs, and the whole JSONL round-trips through
+    ``scripts/trace_report.py`` (tests/test_trace_report.py pins it,
+    ``--check`` clean).
+    """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl
+    from flexflow_tpu.search.serve_search import search_serve_plan
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    # small window: the degraded phase must DISPLACE the healthy mix
+    tel = Telemetry(clock=_Tick(), workload_window=24)
+    scen = calibration_scenario()
+    ff, devices, mm = scen["ff"], scen["devices"], scen["mm_true"]
+    be = mm.spec.spec_break_even_acceptance
+
+    depth = 3
+
+    def offer_rounds(n, accepted_of_drafted):
+        acc, drafted = accepted_of_drafted
+        for _ in range(n):
+            tel.spec_acceptance(acc, drafted)
+
+    # phase 1: the draft tracks the target — 4 of 6 drafted tokens accept
+    # per round (acceptance 0.667 >> the 0.439 break-even)
+    offer_rounds(24, (4, depth * 2))
+    feats_hi = tel.workload.features()
+    plan_hi = search_serve_plan(
+        ff, n_chips=2, machine=mm, devices=devices,
+        workload=dict(scen["ref_feats"],
+                      mean_spec_acceptance=feats_hi["mean_spec_acceptance"]),
+        spec="auto", calibration=None, telemetry=tel)
+
+    # runtime: requests admitted in spec mode; mixed verify rounds (the
+    # composition gauge) through the real schema
+    for i in range(4):
+        tid = f"s{i:05d}"
+        tel.request_enqueued(tid, prompt_len=32)
+        tel.request_admitted(tid, queue_wait_s=0.001)
+        tel.request_first_token(tid, ttft_s=0.01)
+    tel.spec_batch_mix(3, 1)
+    tel.spec_batch_mix(2, 2)
+
+    # phase 2: the workload shifts, acceptance collapses (~0.17 << 0.439)
+    offer_rounds(24, (1, depth * 2))
+    feats_lo = tel.workload.features()
+    plan_lo = search_serve_plan(
+        ff, n_chips=2, machine=mm, devices=devices,
+        workload=dict(scen["ref_feats"],
+                      mean_spec_acceptance=feats_lo["mean_spec_acceptance"]),
+        spec="auto", calibration=None, telemetry=tel)
+    # the operator acts on the recommendation: flip the live rows off
+    for i in range(4):
+        tel.spec_mode_changed(f"s{i:05d}", spec=False)
+        tel.request_finished(f"s{i:05d}", n_tokens=8, tpot_s=0.002)
+    tel.spec_batch_mix(0, 4)
+
+    paths = tel.export(out_dir, prefix="dryrun_spec")
+    snap = tel.metrics.snapshot()
+    return {
+        "paths": paths,
+        "summary": summarize_jsonl(paths["jsonl"]),
+        "break_even_acceptance": round(be, 4),
+        "high_acceptance": {
+            "mean_spec_acceptance":
+                round(feats_hi["mean_spec_acceptance"], 4),
+            "plan_key": plan_hi["plan_key"],
+            "spec": plan_hi["spec"],
+            "tpot_ms": plan_hi["tpot_ms"],
+        },
+        "low_acceptance": {
+            "mean_spec_acceptance":
+                round(feats_lo["mean_spec_acceptance"], 4),
+            "plan_key": plan_lo["plan_key"],
+            "spec": plan_lo["spec"],
+            "tpot_ms": plan_lo["tpot_ms"],
+        },
+        "flipped": ("_spec_" in plan_hi["plan_key"]
+                    and "_spec_" not in plan_lo["plan_key"]),
+        "spec_mode_changes": snap.get("spec_mode_changes"),
+        "spec_batch_spec_frac": snap.get("spec_batch_spec_frac"),
+        "note": "hermetic: live spec_acceptance histogram -> "
+                "acceptance-aware search (spec='auto') -> spec plan above "
+                "break-even, incremental plan below; spec_mode_changed + "
+                "mixed-batch gauges ride the real telemetry schema "
+                "(searches run shape inference, never device programs)",
+    }
+
+
 def bench_shared_prefix(ctx=256, n_users=16, shared_len=1536,
                         suffix_len=128, max_new=32, page=512):
     """DEVICE shared-prefix serving section: N users x one system prompt,
@@ -1800,6 +1908,7 @@ def main(argv=None):
         doc["observability"]["feedback_loop"] = feedback_loop_dryrun(args.out)
         doc["observability"]["memory_ledger"] = memory_ledger_dryrun(args.out)
         doc["observability"]["shared_prefix"] = shared_prefix_dryrun(args.out)
+        doc["observability"]["spec_serving"] = spec_serving_dryrun(args.out)
         print(json.dumps(doc))
         return
 

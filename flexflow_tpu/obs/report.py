@@ -38,6 +38,8 @@ _FAULT = "dispatch_fault"
 # paged-KV prefix sharing (serve/kv_paged.py)
 _PREFIX_HIT = "prefix_hit"
 _PREFIX_MISS = "prefix_miss"
+# speculative production mode (serve/spec_infer.py): runtime mode flips
+_SPEC_MODE = "spec_mode_changed"
 # observe->calibrate->re-plan loop events (obs/drift.py, obs/plan_health.py)
 _DRIFT = "drift_detected"
 _REPLAN = "replan_recommended"
@@ -65,6 +67,7 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     outcomes: Dict[str, int] = {}
     preemptions = retries = faults = 0
     prefix_hits = prefix_misses = 0
+    spec_mode_changes: List[Dict] = []
     drift_events: List[Dict] = []
     replans: List[Dict] = []
     mem_pressure: List[Dict] = []
@@ -90,6 +93,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _PREFIX_MISS:
             prefix_misses += 1
+            continue
+        if name == _SPEC_MODE:
+            spec_mode_changes.append(ev.get("args", {}))
             continue
         if name == _DRIFT:
             drift_events.append(ev.get("args", {}))
@@ -160,6 +166,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         # paged-KV prefix sharing: binds that reused registered pages
         "prefix_hits": prefix_hits,
         "prefix_misses": prefix_misses,
+        # speculative production mode: runtime spec on/off flips
+        "spec_mode_changes": spec_mode_changes,
         # plan feedback loop: drift excursions + replan recommendations
         "drift_detected": drift_events,
         "replan_recommended": replans,

@@ -81,6 +81,10 @@ EVENT_SCHEMA = {
     # reuse registered prefix pages (skipping that much prefill) or not
     "prefix_hit": ("request", ("trace_id",)),
     "prefix_miss": ("request", ("trace_id",)),
+    # speculative serving as a production mode (serve/spec_infer.py): a
+    # request's speculation mode flipped at runtime (``set_spec_mode``) —
+    # ``args.spec`` carries the new mode
+    "spec_mode_changed": ("request", ("trace_id", "spec")),
 }
 
 
@@ -249,6 +253,29 @@ class Telemetry:
         self.workload.observe_occupancy(occ)
         self.trace.counter("batch_slot_occupancy", occ)
         self.trace.counter("kv_cache_utilization", util)
+
+    def spec_mode_changed(self, trace_id: str, spec: bool) -> float:
+        """A request's speculation mode flipped at runtime
+        (``RequestManager.set_spec_mode``): spec rows draft+verify
+        multi-token per macro step, plain rows decode one token — in the
+        SAME mixed batch under a SpecInferManager."""
+        self.metrics.counter("spec_mode_changes").inc()
+        return self.trace.instant("spec_mode_changed", "request", "requests",
+                                  trace_id=trace_id, spec=bool(spec))
+
+    def spec_batch_mix(self, spec_requests: int, plain_requests: int) -> None:
+        """One mixed verify macro-step's request composition: how many
+        rows shipped a draft tree (multi-token verify) vs a root-only
+        tree (single-token decode).  The mixed-batch composition gauge —
+        the observable that a heterogeneous mix really shares one step."""
+        m = self.metrics
+        m.gauge("spec_batch_spec_requests").set(spec_requests)
+        m.gauge("spec_batch_plain_requests").set(plain_requests)
+        total = spec_requests + plain_requests
+        frac = spec_requests / total if total else 0.0
+        m.gauge("spec_batch_spec_frac").set(frac)
+        m.counter("spec_verify_rounds").inc()
+        self.trace.counter("spec_batch_spec_frac", frac)
 
     def spec_acceptance(self, accepted: int, drafted: int) -> float:
         """One speculative verify round's accept result for a request:
@@ -425,6 +452,12 @@ class NullTelemetry:
         return 0.0
 
     def batch_composition(self, *a, **k):
+        return None
+
+    def spec_mode_changed(self, *a, **k):
+        return 0.0
+
+    def spec_batch_mix(self, *a, **k):
         return None
 
     def spec_acceptance(self, *a, **k):

@@ -34,6 +34,17 @@ class TPUSpec:
     step_overhead: float = 3e-6     # per compiled-step dispatch/loop overhead
     train_step_factor: float = 3.0  # whole train step time / forward time
     overlap: float = 0.3            # comm fraction hidden behind compute
+    # speculative serving (serve/spec_infer.py): the draft-token acceptance
+    # rate at which one speculative macro-step (depth draft levels + one
+    # tree-verify pass) costs the same PER TOKEN as incremental decoding —
+    # macro_cost = tpot * (1 + break_even * depth) by definition, so the
+    # serve search prices a spec plan as tpot * (1 + be*d) / (1 + a*d) for
+    # live acceptance a (search/serve_search.py).  MEASURED: BENCH r05's
+    # spec_break_even_acceptance (0.439 at the 7B-slice bench shape,
+    # depth 5); calibratable like every constant here (with_calibration
+    # field + CalibrationStore time-like scaling — a machine whose verify
+    # step is relatively slower than modeled raises the break-even).
+    spec_break_even_acceptance: float = 0.439
 
 
 TPU_SPECS: Dict[str, TPUSpec] = {
@@ -106,7 +117,8 @@ class MachineModel:
         except (OSError, ValueError):
             return self
         fields = ("mxu_efficiency", "vmem_resident_bytes", "step_overhead",
-                  "train_step_factor", "overlap")
+                  "train_step_factor", "overlap",
+                  "spec_break_even_acceptance")
         spec = dataclasses.replace(
             self.spec,
             **{k: float(doc[k]) for k in fields if k in doc},
@@ -120,6 +132,11 @@ class MachineModel:
     _TIME_CONSTANTS = frozenset({
         "step_overhead", "kernel_overhead", "ici_latency", "dcn_latency",
         "train_step_factor",
+        # relatively slower verify/draft steps raise the acceptance needed
+        # to break even — time-like (multiplies by the measured/predicted
+        # ratio), so a CalibrationStore component named after it scales
+        # the spec pricing like any machine constant
+        "spec_break_even_acceptance",
     })
     _RATE_CONSTANTS = frozenset({
         "hbm_bandwidth", "ici_bandwidth", "dcn_bandwidth",

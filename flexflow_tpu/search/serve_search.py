@@ -188,6 +188,62 @@ def _boundary_bytes(graph, split) -> float:
     return worst
 
 
+# default speculation shape for ``spec="auto"`` — SpecInferManager's
+# defaults, so "price what the spec manager would run" needs no extra args
+DEFAULT_SPEC_SHAPE = {"width": 2, "depth": 3}
+
+
+def _spec_options(spec) -> List[Dict]:
+    """Normalize the ``spec`` search dimension: None/False = off,
+    ``"auto"``/True = the default draft shape, a dict = one shape, an
+    iterable of dicts = several shapes (each ``{"width", "depth"}``,
+    optional ``"acceptance"`` override)."""
+    if spec is None or spec is False:
+        return []
+    if spec is True or spec == "auto":
+        return [dict(DEFAULT_SPEC_SHAPE)]
+    if isinstance(spec, dict):
+        return [dict(spec)]
+    return [dict(s) for s in spec]
+
+
+def _spec_factor(machine: MachineModel, feats: Optional[Dict], opt: Dict):
+    """Speculative TPOT multiplier for one draft shape under one machine
+    and workload: ``(1 + break_even*depth) / (1 + acceptance*depth)``.
+
+    The measured break-even acceptance (BENCH r05: the acceptance at
+    which one macro-step — ``depth`` draft levels + one tree-verify pass
+    — costs the same per token as incremental decoding) parametrizes the
+    ENTIRE macro-step overhead as ``macro = tpot * (1 + be*depth)``;
+    expected committed tokens per macro-step are ``1 + acceptance*depth``
+    (the accepted chain + bonus), so the ratio is the spec plan's
+    steady-state TPOT relative to the same tp×pp×m plan decoding
+    incrementally.  ``acceptance`` comes from the workload profile's
+    ``mean_spec_acceptance`` (the live ``spec_acceptance`` histogram the
+    verify rounds feed) unless the option overrides it; a cold profile
+    (0.0) prices spec strictly WORSE than incremental, so the planner
+    never speculates without evidence.  ``break_even`` is the
+    calibratable :class:`TPUSpec` constant — ``with_calibration`` files
+    and CalibrationStore components named ``spec_break_even_acceptance``
+    scale it like any machine constant.
+
+    NOT priced here: the draft model's weights/KV and the spec-tree
+    buffers (co-resident HBM — gate them via ``hbm_cap`` or the spec
+    manager's dual-allocator accounting); a draft much larger than the
+    bench's would also shift the measured break-even.
+
+    Returns ``(factor, acceptance, break_even, depth)``.
+    """
+    depth = int(opt.get("depth", DEFAULT_SPEC_SHAPE["depth"]))
+    acc = opt.get("acceptance")
+    if acc is None:
+        acc = (feats or {}).get("mean_spec_acceptance", 0.0) or 0.0
+    acc = min(max(float(acc), 0.0), 1.0)
+    be = machine.spec.spec_break_even_acceptance
+    factor = (1.0 + be * depth) / (1.0 + acc * depth)
+    return factor, acc, be, depth
+
+
 def _workload_features(workload) -> Optional[Dict[str, float]]:
     """Normalize a workload argument to the plan-facing feature scalars:
     a :class:`~flexflow_tpu.obs.drift.WorkloadProfile`, a features dict,
@@ -296,9 +352,27 @@ def search_serve_plan(
     workload=None,
     calibration="auto",
     kv_page_size=None,
+    spec=None,
 ) -> Dict:
-    """Pick the best (tp, pp, n_micro) for serving ``model``'s graph on
-    ``n_chips`` chips.
+    """Pick the best (tp, pp, n_micro[, spec shape]) for serving
+    ``model``'s graph on ``n_chips`` chips.
+
+    ``spec``: add speculative decoding as a search dimension —
+    ``"auto"`` prices the default draft shape (width 2 / depth 3), a dict
+    or list of dicts prices explicit ``{"width", "depth"}`` shapes.  Each
+    fitting tp×pp×m candidate gains spec variants priced by
+    :func:`_spec_factor`: TPOT scales by ``(1 + break_even*depth) /
+    (1 + acceptance*depth)`` with acceptance read from the workload
+    profile's ``mean_spec_acceptance`` (the live histogram the verify
+    rounds feed) and the MEASURED break-even acceptance a calibratable
+    machine constant (``TPUSpec.spec_break_even_acceptance``, BENCH r05).
+    Above break-even the spec variant wins and the plan key gains a
+    ``_spec_w{w}d{d}`` suffix (+ a ``spec`` sub-dict with the pricing
+    inputs); at or below it the incremental plan is returned — so the
+    planner chooses spec vs tp vs pp PER WORKLOAD, and a
+    PlanHealthMonitor re-searching on a drifted profile recommends
+    flipping spec off when live acceptance degrades.  None (default)
+    prices exactly as before.
 
     ``kv_page_size``: the deployment serves with the paged KV cache
     (serve/kv_paged.py) — the KV stream prices block-granularly (live
@@ -380,6 +454,7 @@ def search_serve_plan(
 
     feats = _workload_features(workload)
     store = _resolve_store(calibration)
+    spec_opts = _spec_options(spec)
     rows = _graph_rows(graph, attn0)
     knobs = _workload_knobs(feats, max_seq, kv_page_size)
     kv_fill = knobs["kv_fill_frac"]
@@ -395,6 +470,7 @@ def search_serve_plan(
     candidates: Dict[str, Dict] = {}
     raw_parts_by_plan: Dict[str, Dict] = {}
     best = None
+    spec_be = None  # break-even the spec variants were priced against
     for tp in range(1, n_chips + 1):
         if n_chips % tp or kv_heads % tp:
             continue
@@ -445,35 +521,66 @@ def search_serve_plan(
             tpot_s = cost["tpot_s"] * s_tpot
             ttft_s = (cost["ttft_s"] * s_ttft
                       if cost["ttft_s"] is not None else None)
-            # ranking objective: per-generated-token cost — amortize the
-            # first token's latency over the expected output length
-            obj = tpot_s
-            if ttft_s is not None and out_len > 0:
-                obj = tpot_s + ttft_s / out_len
             by_m[str(m)] = {
                 "tpot_ms": round(tpot_s * 1e3, 4),
                 "bubble_frac": round(cost["bubble_frac"], 4),
                 "transfer_ms": round(cost["transfer_s"] * s_xfer * 1e3, 5),
             }
-            if ttft_s is not None:
-                by_m[str(m)]["ttft_ms"] = round(ttft_s * 1e3, 4)
-                by_m[str(m)]["objective_ms"] = round(obj * 1e3, 4)
-            if entry["fits"] and (best is None
-                                  or obj < best["objective_s"]):
-                best = {
-                    "tp": tp, "pp": pp, "n_micro": m,
-                    "tpot_s": tpot_s,
-                    "objective_s": obj,
-                    "tpot_ms": round(tpot_s * 1e3, 4),
-                    "bubble_frac": round(cost["bubble_frac"], 4),
-                    "transfer_ms": round(cost["transfer_s"] * s_xfer
-                                         * 1e3, 5),
-                    "prefill_util": cost["prefill_util"],
-                    "per_stage_gb": entry["per_stage_gb"],
-                }
-                if ttft_s is not None:
-                    best["ttft_ms"] = round(ttft_s * 1e3, 4)
-                    best["objective_ms"] = round(obj * 1e3, 4)
+            # variants: the incremental plan plus one spec variant per
+            # draft shape (acceptance-aware pricing; the incremental plan
+            # is evaluated FIRST, so at exactly break-even — factor 1.0 —
+            # the strict < keeps the non-spec plan: speculation must EARN
+            # its extra machinery)
+            for sopt in [None] + spec_opts:
+                sinfo = None
+                v_tpot = tpot_s
+                if sopt is not None:
+                    factor, acc, be, depth = _spec_factor(mm, feats, sopt)
+                    spec_be = be
+                    v_tpot = tpot_s * factor
+                    sinfo = {
+                        "width": int(sopt.get("width",
+                                              DEFAULT_SPEC_SHAPE["width"])),
+                        "depth": depth,
+                        "acceptance": round(acc, 4),
+                        "break_even": round(be, 4),
+                        "factor": round(factor, 4),
+                        "tokens_per_step": round(1.0 + acc * depth, 4),
+                    }
+                # ranking objective: per-generated-token cost — amortize
+                # the first token's latency over the expected output
+                # length (speculation never changes TTFT: prefill is not
+                # speculated)
+                obj = v_tpot
+                if ttft_s is not None and out_len > 0:
+                    obj = v_tpot + ttft_s / out_len
+                if sopt is not None:
+                    by_m[str(m)].setdefault("spec", {})[
+                        f"w{sinfo['width']}d{sinfo['depth']}"] = {
+                        "tpot_ms": round(v_tpot * 1e3, 4),
+                        "factor": sinfo["factor"],
+                        "acceptance": sinfo["acceptance"],
+                    }
+                elif ttft_s is not None:
+                    by_m[str(m)]["ttft_ms"] = round(ttft_s * 1e3, 4)
+                    by_m[str(m)]["objective_ms"] = round(obj * 1e3, 4)
+                if entry["fits"] and (best is None
+                                      or obj < best["objective_s"]):
+                    best = {
+                        "tp": tp, "pp": pp, "n_micro": m,
+                        "tpot_s": v_tpot,
+                        "objective_s": obj,
+                        "tpot_ms": round(v_tpot * 1e3, 4),
+                        "bubble_frac": round(cost["bubble_frac"], 4),
+                        "transfer_ms": round(cost["transfer_s"] * s_xfer
+                                             * 1e3, 5),
+                        "prefill_util": cost["prefill_util"],
+                        "per_stage_gb": entry["per_stage_gb"],
+                        "spec": sinfo,
+                    }
+                    if ttft_s is not None:
+                        best["ttft_ms"] = round(ttft_s * 1e3, 4)
+                        best["objective_ms"] = round(obj * 1e3, 4)
         entry["by_micro"] = by_m
         candidates[f"tp{tp}_pp{pp}"] = entry
 
@@ -484,6 +591,14 @@ def search_serve_plan(
         )
     best["candidates"] = candidates
     best["plan_key"] = f"tp{best['tp']}_pp{best['pp']}_m{best['n_micro']}"
+    if best.get("spec"):
+        best["plan_key"] += (f"_spec_w{best['spec']['width']}"
+                             f"d{best['spec']['depth']}")
+    if spec_opts and spec_be is not None:
+        # the flip threshold the decision was priced against — visible in
+        # the spec_serving dry-run bench section even when the non-spec
+        # plan wins
+        best["spec_break_even"] = round(spec_be, 4)
     best["memory_parts_gb"] = \
         candidates[f"tp{best['tp']}_pp{best['pp']}"]["memory_parts_gb"]
     if feats:
@@ -526,6 +641,7 @@ def price_plan(
     spec_name: Optional[str] = None,
     workload=None,
     kv_page_size=None,
+    spec=None,
 ) -> Dict:
     """Price ONE tp x pp x m factorization with the same stage-split and
     cost machinery :func:`search_serve_plan` ranks with.
@@ -535,6 +651,12 @@ def price_plan(
     true constants in a simulation, or re-calibrated ones after a store
     update), what would the cost model have said?  No memory gate, no
     calibration store — this prices, it does not choose.
+
+    ``spec``: a single draft shape dict (``{"width", "depth"}``, optional
+    ``"acceptance"``) — the replayed TPOT scales by the SAME
+    :func:`_spec_factor` the chooser used, so a spec-plan calibration
+    pair compares like against like (a chooser-vs-replay modeling gap
+    would launder into the store as fake machine skew).
     """
     import jax
 
@@ -553,7 +675,8 @@ def price_plan(
     plans = build_stage_plans(graph, split, strategy, [mesh] * pp)
     attn0 = next(n for n in graph.nodes
                  if isinstance(n.op, IncMultiHeadSelfAttention))
-    knobs = _workload_knobs(_workload_features(workload),
+    feats = _workload_features(workload)
+    knobs = _workload_knobs(feats,
                             getattr(attn0.op, "cost_seq_len", None),
                             kv_page_size)
     knobs.pop("out_len")  # pricing knob only for the ranking objective
@@ -564,6 +687,16 @@ def price_plan(
         **knobs,
     )
     cost["plan_key"] = f"tp{tp}_pp{pp}_m{n_micro}"
+    if spec:
+        sopt = dict(spec)
+        factor, acc, be, depth = _spec_factor(mm, feats, sopt)
+        width = int(sopt.get("width", DEFAULT_SPEC_SHAPE["width"]))
+        cost["tpot_s"] = cost["tpot_s"] * factor
+        cost["spec"] = {"width": width, "depth": depth,
+                        "acceptance": round(acc, 4),
+                        "break_even": round(be, 4),
+                        "factor": round(factor, 4)}
+        cost["plan_key"] += f"_spec_w{width}d{depth}"
     cost["tpot_ms"] = round(cost["tpot_s"] * 1e3, 4)
     cost["transfer_ms"] = round(cost["transfer_s"] * 1e3, 5)
     if cost["ttft_s"] is not None:

@@ -307,6 +307,16 @@ class TreeVerifyBatchConfig:
     verified with the tree-topology causal mask — plus the commit descriptor:
     tokens accepted in the *previous* macro-step whose KV (saved in the spec
     buffer) must be copied into the committed cache before attending.
+
+    **Mixed spec/non-spec batches** (ISSUE 11): a request in plain decode
+    mode rides the same verify step as a DEGENERATE root-only tree — one
+    node (its decode token) whose ancestor mask is just the self bit.
+    The tree attention of a single root node reduces exactly to ordinary
+    decode attention over the committed prefix, so spec rows verify
+    multi-token while plain rows decode one token in one batched step;
+    the accept walk trivially emits the plain row's sampled/argmax token
+    (no children to match).  Builders: ``SpecInferManager._draft_phase``
+    (host) and ``SpecDecodeScan`` with ``spec_mask`` (on-device).
     """
 
     base: BatchConfig

@@ -207,10 +207,20 @@ class PipelinedInferenceManager:
     pipeline through the stages; prefill chunks ride whole (successive
     chunks already interleave across stages via async dispatch).
 
-    Not yet supported here: speculative decoding (``max_spec_tokens``) and
-    the on-device prefill scan — both need the single-program pipelining
-    this multi-program design trades away; chunked prefill covers the
-    prompt phase instead.
+    **Speculative serving composes** (``max_spec_tokens > 0``): each stage
+    allocates its layers' spec-tree buffers alongside the committed KV,
+    and the host-built ``TreeSearchBatchConfig``/``TreeVerifyBatchConfig``
+    batches ride the stage chain WHOLE (like prefill chunks) — the
+    tree-verify step is just another batch shape hopping the live-cut
+    boundary, so :class:`~.spec_infer.SpecInferManager` drives a
+    pipelined target with the draft model co-resident on its own devices
+    (the dual-allocator accounting the spec manager already does).  The
+    on-device ``SpecDecodeScan`` stays single-program (it calls
+    ``_step_impl`` directly); spec × pp serves through the host manager.
+
+    Not yet supported here: the on-device prefill scan — it needs the
+    single-program pipelining this multi-program design trades away;
+    chunked prefill covers the prompt phase instead.
     """
 
     # shared with RequestManager like InferenceManager.telemetry; stage
@@ -238,6 +248,7 @@ class PipelinedInferenceManager:
         gate_lm_head: bool = True,
         topk: int = 0,
         kv_page_size: Optional[int] = None,
+        max_spec_tokens: int = 0,
     ):
         from ..parallel.mesh import make_mesh
 
@@ -245,7 +256,7 @@ class PipelinedInferenceManager:
         self.max_requests = max_requests
         self.max_tokens = max_tokens_per_batch
         self.max_seq_len = max_seq_len
-        self.max_spec_tokens = 0
+        self.max_spec_tokens = max_spec_tokens
         self.topk = topk
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
@@ -280,7 +291,7 @@ class PipelinedInferenceManager:
             self.n_micro = fixed
 
         register_serve_capacities(model.graph, max_requests, max_seq_len,
-                                  0, kv_dtype)
+                                  max_spec_tokens, kv_dtype)
         if outputs is None:
             out_tids = [model.graph.nodes[-1].outputs[-1]]
         else:
@@ -321,7 +332,8 @@ class PipelinedInferenceManager:
         # single-plan manager's.
         stage_kvs = [
             StageKV(stage.nodes, strategy, stage.mesh, max_requests,
-                    max_seq_len, 0, always_place=True, label=f"stage{s}")
+                    max_seq_len, max_spec_tokens, always_place=True,
+                    label=f"stage{s}")
             for s, stage in enumerate(self.stages)
         ]
         for stage, skv in zip(self.stages, stage_kvs):

@@ -89,6 +89,14 @@ class Request:
     # allocator's release() on every slot-leaving path, carried on finish
     # telemetry and serving records
     kv_bytes: float = 0.0
+    # speculative serving (serve/spec_infer.py): per-request speculation
+    # mode, set at admission (``register_new_request(spec=...)``) and
+    # flippable at runtime (``set_spec_mode``).  Under a SpecInferManager,
+    # spec rows carry a draft-token tree and verify multi-token per macro
+    # step while plain rows decode one token in the SAME verify batch;
+    # under a plain RequestManager the flag is inert (everything rides the
+    # incremental loop).
+    spec: bool = False
 
     @property
     def prefill_tokens(self) -> List[int]:
@@ -120,6 +128,11 @@ class GenerationConfig:
 
 class RequestManager:
     request_cls = Request  # subclasses (SpecInferManager) extend the record
+    # speculation mode new requests default to (``register_new_request``'s
+    # ``spec=None``): the plain manager serves everything incrementally;
+    # SpecInferManager flips this to True so its historical all-spec
+    # behavior is unchanged unless a caller opts rows out per request
+    default_spec_mode = False
 
     def __init__(self, im, gen_config: Optional[GenerationConfig] = None,
                  telemetry=None, resilience: Optional[ResilienceConfig] = None,
@@ -135,7 +148,6 @@ class RequestManager:
         self.steps = 0
         self.tokens_decoded = 0
         self.scan_runs = 0      # decode stretches run as on-device scans
-        self._sample_calls = 0  # folds the per-call key for seeded sampling
         # ONE Telemetry handle across the serving stack: syncing it onto the
         # InferenceManager (which forwards to pipeline stages) puts request
         # lifecycle, dispatch spans, and per-stage events on one clock/ring.
@@ -197,22 +209,6 @@ class RequestManager:
             plan_health.kv_allocator = kv
         self._health_ticks = 0
 
-    def _sample_arg(self):
-        """Legacy per-call sampling arg ``(key, temperature, top_p)``, or
-        None for greedy — still used by the speculative phases, whose
-        verify/draft steps have no per-request token index to key on."""
-        if self.gen.temperature <= 0.0:
-            return None
-        import jax
-        import jax.numpy as jnp
-
-        self._sample_calls += 1
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self.gen.seed), self._sample_calls
-        )
-        return (key, jnp.float32(self.gen.temperature),
-                jnp.float32(self.gen.top_p))
-
     @staticmethod
     def _fold_for(req: Request) -> Tuple[int, int]:
         """THE per-request sample-key fold: (rid, index of the token about
@@ -233,6 +229,13 @@ class RequestManager:
         serving bit-identity contract (tests/test_resilience.py).  Rows
         without a sample point draw from the (0, 0) fold; their samples are
         computed and discarded.  None for greedy.
+
+        ``points`` entries are ``(row, rid)`` or ``(row, rid, offset)`` —
+        the optional offset shifts the token index past ``len(generated)``
+        (the speculative verify step samples index ``len(generated) +
+        tree_depth`` per row; ONE assembly path for every sampled
+        dispatch, so the fold scheme cannot silently diverge between the
+        incremental and speculative paths).
         """
         if self.gen.temperature <= 0.0:
             return None
@@ -240,8 +243,9 @@ class RequestManager:
         import jax.numpy as jnp
 
         folds = np.zeros((n_rows, 2), np.int32)
-        for row, rid in points:
-            folds[row] = self._fold_for(self.requests[rid])
+        for row, rid, *off in points:
+            rid_fold, idx = self._fold_for(self.requests[rid])
+            folds[row] = (rid_fold, idx + (off[0] if off else 0))
         return (jax.random.PRNGKey(self.gen.seed),
                 jnp.float32(self.gen.temperature),
                 jnp.float32(self.gen.top_p), jnp.asarray(folds))
@@ -342,7 +346,7 @@ class RequestManager:
         max_new_tokens: Optional[int] = None, *,
         priority: int = 0, ttl_s: Optional[float] = None,
         deadline_s: Optional[float] = None, reject_invalid: bool = False,
-        reject_reason: Optional[str] = None,
+        reject_reason: Optional[str] = None, spec: Optional[bool] = None,
     ) -> int:
         """Register a request; returns its rid.
 
@@ -356,13 +360,17 @@ class RequestManager:
         take the explicit ``REJECTED``-outcome path.  ``ttl_s`` (relative)
         or ``deadline_s`` (absolute on the manager's clock) arm a per-
         request deadline; ``max_new_tokens=0`` completes immediately with
-        an ``ok`` outcome and zero tokens.
+        an ``ok`` outcome and zero tokens.  ``spec`` sets the request's
+        speculation mode (None = the manager's ``default_spec_mode``);
+        meaningful under a :class:`~.spec_infer.SpecInferManager`, inert
+        otherwise.
         """
         req = self.request_cls(
             -1,
             list(int(t) for t in prompt_tokens),
             self.gen.max_new_tokens if max_new_tokens is None else int(max_new_tokens),
         )
+        req.spec = bool(self.default_spec_mode if spec is None else spec)
         # reject_reason: caller-side invalidity (e.g. malformed arrival
         # options) that must take the REJECTED path like any shape error
         err = reject_reason if reject_reason is not None \
@@ -429,6 +437,29 @@ class RequestManager:
             return False
         req.cancel_requested = True
         return True
+
+    def set_spec_mode(self, rid: int, enabled: bool) -> bool:
+        """Flip a live request's speculation mode at runtime; returns
+        whether it was live.  Takes effect at the next macro-step/tick
+        boundary — in-flight device work is never interrupted, so a flip
+        can never change already-committed tokens.  Under a plain
+        RequestManager the flag is inert; SpecInferManager reacts via
+        :meth:`_on_spec_flip` (draft-cache catch-up on enable)."""
+        req = self.requests.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        enabled = bool(enabled)
+        if req.spec == enabled:
+            return True
+        req.spec = enabled
+        self._on_spec_flip(req)
+        if self.telemetry.enabled:
+            self.telemetry.spec_mode_changed(req.trace_id, spec=enabled)
+        return True
+
+    def _on_spec_flip(self, req: Request) -> None:
+        """Hook for managers that keep per-mode state (the spec manager
+        rebuilds the draft model's catch-up feed on enable)."""
 
     def _release_slot(self, req: Request) -> None:
         if req.slot >= 0:
@@ -536,9 +567,12 @@ class RequestManager:
             tel.request_preempted(req.trace_id,
                                   recompute_tokens=len(req.prefill_src))
 
-    # whether dispatch-failure recovery may requeue-and-recompute (the
-    # incremental paths re-prefill prompt+generated; SpecInferManager has
-    # no recompute story, so its failures go terminal regardless)
+    # whether dispatch-failure recovery may requeue-and-recompute by
+    # re-prefilling prompt+generated — True across the serving stack
+    # (SpecInferManager included since ISSUE 11: its preempt() resets the
+    # spec bookkeeping and the readmission re-prefills BOTH models'
+    # caches); a subclass without a recompute story would flip this off
+    # to make its failures go terminal instead
     supports_recompute = True
 
     def _rids_in_batch(self, bc) -> List[int]:
@@ -1165,6 +1199,18 @@ class RequestManager:
                 self.process_result(result, sample_points)
             self.steps += 1
 
+    def _tick(self) -> None:
+        """One unit of serving work between lifecycle checks — THE
+        dispatch the serve loops (``serve_incr_decoding`` and
+        ``serve_with_arrivals``) drive.  The incremental manager's tick is
+        :meth:`_serve_tick`; :class:`~.spec_infer.SpecInferManager`
+        overrides this with its spec-aware dispatch (a mixed speculative
+        macro-step while any live request is in spec mode, the incremental
+        fast path otherwise), which is what makes speculation compose with
+        arrivals, deadlines, cancellation, and admission control with ONE
+        lifecycle implementation."""
+        self._serve_tick()
+
     def _kv_bind(self, rid: int) -> None:
         """Attribution hook when a request takes a slot (overridden by
         managers holding more than one deployment's caches — the spec
@@ -1293,7 +1339,8 @@ class RequestManager:
         max_new_tokens_or_None)`` — offsets from loop start; admitted once
         the clock passes them.  An optional 4th element is an options dict
         forwarded to :meth:`register_new_request` (``priority``, ``ttl_s``,
-        ``deadline_s``).  ``clock``: 0-arg seconds callable (injectable for
+        ``deadline_s``, ``spec`` — per-request speculation mode under a
+        SpecInferManager).  ``clock``: 0-arg seconds callable (injectable for
         hermetic tests; default ``time.perf_counter``); it also drives the
         deadline/TTL checks for the loop's duration.  ``quantum``: cap on
         the on-device decode-scan stretch while arrivals are outstanding,
@@ -1346,12 +1393,14 @@ class RequestManager:
                 # out of (and killing) the serve loop
                 opts, reject = {}, None
                 if rest:
-                    known = {"priority", "ttl_s", "deadline_s"}
+                    known = {"priority", "ttl_s", "deadline_s", "spec"}
                     if (isinstance(rest[0], dict)
                             and not set(rest[0]) - known):
                         try:
                             opts = {
-                                k: (int(v) if k == "priority" else float(v))
+                                k: (int(v) if k == "priority"
+                                    else bool(v) if k == "spec"
+                                    else float(v))
                                 for k, v in rest[0].items() if v is not None}
                         except (TypeError, ValueError):
                             opts, reject = {}, \
@@ -1404,7 +1453,7 @@ class RequestManager:
                     continue
                 self.scan_chunk = quantum if pending else saved_chunk
                 starters = prefill_starters()
-                self._serve_tick()
+                self._tick()
                 self._sync_kv()
                 self._maybe_check_health()
                 for rid in starters:
@@ -1453,7 +1502,7 @@ class RequestManager:
             self._check_lifecycle()
             if not self.has_work():
                 break
-            self._serve_tick()
+            self._tick()
             self._sync_kv()
             self._maybe_check_health()
         self._maybe_check_health(force=True)

@@ -24,6 +24,33 @@ programs total — SSM inc/tree-search, LLM inc/tree-verify):
 
 Greedy invariant (tested): output sequences are EXACTLY those of plain
 incremental decoding with the LLM, for any draft model.
+
+**Mixed spec/non-spec batches (first-class production mode).**  Speculation
+is a PER-REQUEST scheduling decision: ``register_new_request(spec=...)``
+sets the mode at admission (default True under this manager) and
+``set_spec_mode`` flips it at runtime.  Non-spec rows join the same verify
+macro-step as degenerate root-only trees — their single node is the decode
+token, the accept walk trivially emits one target-sampled token — so a
+heterogeneous mix runs in ONE batched LLM step: spec rows verify
+multi-token, plain rows decode one token.  While NO live request is in
+spec mode, the manager's tick degrades to the incremental fast path
+(decode stretches/scans included) after flushing any pending spec commits
+into the committed cache, so an all-plain population never pays the
+macro-step overhead.
+
+**Seeded-sampling bit-identity.**  Every sampled dispatch in the spec
+phases keys on the r9 ``(rid, token_index)`` fold (a verify row at tree
+depth ``d`` samples generated-token index ``len(generated) + d``), so
+sampled speculative serving is BIT-IDENTICAL to sampled incremental
+decoding — which is what makes mixed batches, recompute recovery, and
+mode flips composable: a token's value depends only on (seed, rid, index)
+and the committed prefix, never on which serving path produced it.
+
+**Recompute recovery.**  ``supports_recompute`` is True: a dispatch fault
+past the retry budget (or slot/page pressure) preempts the affected
+requests through the r9 path — spec bookkeeping (tree, pending commits,
+committed depths) resets, the readmission re-prefills prompt+generated
+into BOTH models' caches, and the recomputed tokens are bit-identical.
 """
 
 from __future__ import annotations
@@ -79,9 +106,14 @@ class SpecInferManager(RequestManager):
     """
 
     request_cls = SpecRequest
-    # dispatch failures past the retry budget go terminal: the three-phase
-    # macro step's committed-depth bookkeeping has no recompute path
-    supports_recompute = False
+    # inherited speculation semantics: requests default to spec mode (the
+    # historical all-spec behavior); callers opt rows out per request
+    default_spec_mode = True
+    # dispatch failures recover through the r9 preemption-and-recompute
+    # path: preempt() resets the spec bookkeeping and readmission
+    # re-prefills prompt+generated into both models' caches (bit-identical
+    # for greedy AND seeded sampling — the (rid, token_index) fold)
+    supports_recompute = True
 
     def __init__(
         self,
@@ -100,14 +132,6 @@ class SpecInferManager(RequestManager):
                          resilience=resilience,
                          fault_injector=fault_injector, clock=clock,
                          plan_health=plan_health)
-        if self.res.preemption:
-            # recompute-based preemption needs the incremental prefill
-            # paths (prefill_src); the spec macro-step's three-phase cache
-            # bookkeeping (llm/ssm committed depths) has no recompute story
-            raise ValueError(
-                "ResilienceConfig.preemption is not supported by "
-                "SpecInferManager (recovery is recompute-based and only "
-                "the incremental serving paths recompute)")
         self.llm = llm
         self.ssm = ssm
         self.width = width
@@ -174,14 +198,54 @@ class SpecInferManager(RequestManager):
     def _release_slot(self, req: Request) -> None:
         if req.slot < 0:
             return
-        # draft share first (super() clears req.slot); spec serving has
-        # no preemption, so a request binds exactly once and the target
-        # (max-stamped by super) + draft shares sum exactly
+        # both deployments release on every slot-leaving path (terminal
+        # outcomes AND preemption — spec requests recompute now, so a
+        # request can bind more than once): the combined target+draft
+        # bytes of THIS binding epoch max-combine with previous epochs'
+        # stamp, so the recorded peak is what the request really held
         kv_s = getattr(self.ssm, "kv", None)
         draft = (kv_s.release(req.rid, tokens=req.ssm_committed)
                  if kv_s is not None else 0.0)
-        super()._release_slot(req)
-        req.kv_bytes += draft
+        kv_l = getattr(self.llm, "kv", None)
+        target = (kv_l.release(req.rid, tokens=req.seq_len)
+                  if kv_l is not None else 0.0)
+        self.slots[req.slot] = None
+        req.slot = -1
+        req.kv_bytes = max(req.kv_bytes, target + draft)
+
+    def preempt(self, rid: int) -> None:
+        """Recompute-based spec preemption (lifts the r9 restriction):
+        the slot + BOTH caches release, the tree/commit/committed-depth
+        bookkeeping resets, and readmission re-prefills prompt+generated
+        into the LLM AND the SSM (``_prefill_phase`` feeds
+        ``prefill_tokens``), after which served tokens are bit-identical
+        to an unpreempted run for greedy and seeded sampling — the spec
+        phases key every sample on the same (rid, token_index) fold the
+        incremental paths use."""
+        super().preempt(rid)
+        req = self.requests[rid]
+        req.pending_commit = []
+        req.tree = []
+        req.llm_committed = 0
+        req.ssm_committed = 0
+        req.ssm_backlog = []
+
+    def _on_spec_flip(self, req: Request) -> None:
+        """Runtime mode flip.  Enabling speculation mid-decode rebuilds
+        the draft model's catch-up feed when it lags (``_ssm_sync``) —
+        the SSM committed cache must hold every position before the next
+        draft root, and a request that served non-spec rounds left it
+        behind.  Disabling needs nothing — the row stops drafting at the
+        next macro step and any pending commit flows through the next
+        verify batch (or the incremental-path flush)."""
+        if req.spec:
+            self._ssm_sync(req)
+
+    def _token_at(self, req: Request, p: int) -> int:
+        """The logical token at sequence position ``p`` (prompt, then
+        generated — the one layout every cache position maps to)."""
+        return (req.prompt[p] if p < len(req.prompt)
+                else req.generated[p - len(req.prompt)])
 
     def _combine_snaps(self, snap: Dict, snap_s: Dict, kv_l, kv_s) -> Dict:
         """Fold the draft allocator's snapshot into the target's: summed
@@ -246,35 +310,39 @@ class SpecInferManager(RequestManager):
     # ------------------------------------------------------------------
     def _prefill_phase(self):
         self._admit()
-        # LLM prefill for new requests (chunked by the LLM token budget)
+        # LLM prefill for new requests (chunked by the LLM token budget).
+        # The feed is ``prefill_tokens`` — the prompt, or prompt+generated
+        # while recovering from preemption (recompute), exactly like the
+        # incremental prefill paths.
         while True:
             toks, reqi, pos, points, spans = [], [], [], [], []
             budget = self.llm.max_tokens
             for req in self._active():
                 if req.status is not RequestStatus.PREFILLING or budget <= 0:
                     continue
-                take = min(budget, len(req.prompt) - req.prefill_offset)
+                feed = req.prefill_tokens
+                take = min(budget, len(feed) - req.prefill_offset)
                 st = req.prefill_offset
-                toks += req.prompt[st : st + take]
+                toks += feed[st : st + take]
                 reqi += [req.slot] * take
                 pos += list(range(st, st + take))
                 if take:
                     spans.append((req.rid, st, st + take))
                 req.prefill_offset += take
                 budget -= take
-                if req.prefill_offset == len(req.prompt):
+                if req.prefill_offset == len(feed):
                     points.append((len(toks) - 1, req.rid))
             if not toks:
                 break
             self._kv_prepare(spans)
             bc = self._plain_bc(self.llm, toks, reqi, pos)
-            # sample arg so the first generated token (read off the last
-            # prompt position's logits) honors temperature/top_p.  All
-            # phase dispatches run under the retry guard: a fault past the
-            # budget fails only the in-flight requests (no recompute here).
-            # The sample key is drawn ONCE outside the guard so a retried
-            # dispatch replays the identical step.
-            smp = self._sample_arg()
+            # per-request (rid, token_index) sample folds so the first
+            # generated token (read off the last fed position's logits) is
+            # bit-identical to the incremental loop's — for fresh prompts
+            # AND recompute re-prefills.  All phase dispatches run under
+            # the retry guard; the fold schedule is deterministic, so a
+            # retried dispatch replays the identical step.
+            smp = self._sample_for(points, self.llm.max_tokens)
             result = self._guarded(
                 "spec_prefill",
                 lambda b=bc, s=smp: self.llm.step(b, sample=s))
@@ -284,23 +352,36 @@ class SpecInferManager(RequestManager):
             ids = np.asarray(result.token_ids)
             for flat, rid in points:
                 req = self.requests[rid]
+                if req.status is not RequestStatus.PREFILLING:
+                    continue  # left the slot between build and readback
                 req.status = RequestStatus.DECODING
-                req.llm_committed = len(req.prompt)
+                req.llm_committed = len(req.prefill_tokens)
                 self._append_token(req, int(ids[flat]))
                 self._maybe_finish(req)
 
-        # SSM prefill (prompt) + catch-up (tokens accepted by previous rounds)
+        # SSM prefill (prompt / recompute feed) + catch-up (tokens accepted
+        # by previous rounds).  Non-spec rows skip the draft model entirely
+        # — their SSM cache rebuilds from scratch on a later flip-on or
+        # activation (``_ssm_sync``).
+        for req in self._active():
+            if req.spec:
+                # a row may reach the macro path with a lagging SSM side
+                # (flip-on, or incremental-path ticks before activation)
+                self._ssm_sync(req)
         while True:
             toks, reqi, pos, spans = [], [], [], []
             budget = self.ssm.max_tokens
             for req in self._active():
                 if budget <= 0:
                     break
+                if not req.spec:
+                    continue
                 lo = len(pos)
-                if req.ssm_committed < len(req.prompt):
-                    take = min(budget, len(req.prompt) - req.ssm_committed)
+                feed = req.prefill_tokens
+                if req.ssm_committed < len(feed):
+                    take = min(budget, len(feed) - req.ssm_committed)
                     st = req.ssm_committed
-                    toks += req.prompt[st : st + take]
+                    toks += feed[st : st + take]
                     reqi += [req.slot] * take
                     pos += list(range(st, st + take))
                     req.ssm_committed += take
@@ -338,16 +419,33 @@ class SpecInferManager(RequestManager):
     # phase B: draft-tree expansion through the SSM
     # ------------------------------------------------------------------
     def _draft_phase(self) -> List[SpecRequest]:
-        drafting = [r for r in self._active() if r.status is RequestStatus.DECODING]
-        if not drafting:
+        """Build every DECODING request's speculation tree for this round.
+
+        Spec-mode rows expand ``depth`` beam levels through the SSM;
+        non-spec rows get a degenerate ROOT-ONLY tree (their decode token)
+        — the mixed-batch lever: both populations then verify in ONE
+        LLM step (:meth:`_verify_phase`), spec rows multi-token, plain
+        rows one token.  Returns the full verifying list."""
+        decoding = [r for r in self._active()
+                    if r.status is RequestStatus.DECODING]
+        if not decoding:
             return []
         P = self.ssm.max_spec_tokens
         R = self.ssm.max_requests
         masks = np.zeros((R, P, P), bool)
-        for req in drafting:
+        for req in decoding:
+            # macro-boundary invariant: the LLM's committed depth is the
+            # cache prefix before the root (= seq_len - 1).  A row that
+            # served incremental ticks (all-plain phases) advanced its
+            # cache without this bookkeeping — resync is a no-op for rows
+            # in continuous speculative service.
+            req.llm_committed = req.seq_len - 1
             req.tree = [TokenTreeNode(req.generated[-1], -1, 0, 0.0)]
             masks[req.slot, 0, 0] = True
 
+        drafting = [r for r in decoding if r.spec]
+        if not drafting:
+            return decoding
         frontier = {req.rid: [0] for req in drafting}  # node indices at depth d
         # feeding depth-d nodes yields depth-(d+1) children; final-depth nodes
         # are never fed (their KV is only needed by the LLM's verify pass)
@@ -399,7 +497,7 @@ class SpecInferManager(RequestManager):
                     masks[req.slot, idx, idx] = True
                     nxt.append(idx)
                 frontier[req.rid] = nxt
-        return drafting
+        return decoding
 
     def _tree_bc(self, cls, im, toks, reqi, pos, spec, masks, committed_attr,
                  commit=None):
@@ -441,15 +539,22 @@ class SpecInferManager(RequestManager):
     # ------------------------------------------------------------------
     # phase C: LLM tree verification + accept walk
     # ------------------------------------------------------------------
-    def _verify_phase(self, drafting: List[SpecRequest]):
-        if not drafting:
+    def _verify_phase(self, verifying: List[SpecRequest]):
+        """ONE batched LLM step over every decoding row's tree — the
+        mixed macro-step: spec rows ship their whole draft tree (verify
+        multi-token), plain rows ship a root-only tree (decode one
+        token).  The accept walk + commit bookkeeping are identical for
+        both; a root-only tree trivially accepts zero children and emits
+        the bonus token."""
+        if not verifying:
             return
+        tel = self.telemetry
         R = self.llm.max_requests
         P = self.llm.max_spec_tokens
         masks = np.zeros((R, P, P), bool)
         toks, reqi, pos, spec, index_of = [], [], [], [], {}
         commit, spans = [], []
-        for req in drafting:
+        for req in verifying:
             for ni, node in enumerate(req.tree):
                 masks[req.slot, ni, ni] = True
                 if node.parent >= 0:
@@ -475,21 +580,39 @@ class SpecInferManager(RequestManager):
             committed_attr="llm_committed", commit=commit,
         )
         # stochastic verification: with temperature > 0 the verify step
-        # SAMPLES y ~ p(target | node prefix) per tree node (seeded, top-p)
-        # and the walk accepts a child iff its token equals y — every
-        # emitted token is a fresh target-conditional draw, so the output
-        # distribution equals plain sampled incremental decoding's (see
-        # spec_scan._macro_body for the acceptance-rate tradeoff vs the
-        # p/q-ratio rule).  T<=0 keeps the exact-greedy walk.
-        smp = self._sample_arg()
-        result = self._guarded(
-            "spec_verify", lambda: self.llm.step(bc, sample=smp))
+        # SAMPLES y ~ p(target | node prefix) per tree node (seeded,
+        # top-p) and the walk accepts a child iff its token equals y.
+        # Each row's key folds (rid, generated-token index): a node at
+        # tree depth d samples index len(generated)+d — the SAME key the
+        # incremental loop would use for that token, so sampled spec
+        # output is BIT-IDENTICAL to sampled incremental decoding (not
+        # merely distribution-equal), which is what the mixed-batch and
+        # recompute bit-identity contracts rest on.  T<=0 keeps the
+        # exact-greedy walk.
+        smp = self._verify_sample(verifying, index_of)
+        n_spec = sum(1 for r in verifying if len(r.tree) > 1)
+        n_plain = len(verifying) - n_spec
+        if tel.enabled:
+            tel.spec_batch_mix(n_spec, n_plain)
+        with tel.span("spec_verify_round", cat="spec", track="spec",
+                      n_spec=n_spec, n_plain=n_plain,
+                      tree_tokens=len(toks)):
+            result = self._guarded(
+                "spec_verify", lambda: self.llm.step(bc, sample=smp))
         if result is None:
             return
         self.llm_steps += 1
         ids = np.asarray(result.token_ids)
 
-        for req in drafting:
+        for req in verifying:
+            if req.status is not RequestStatus.DECODING:
+                # the request left its slot between list build and
+                # readback (page-pressure preemption inside _kv_prepare
+                # resets its tree; a lifecycle reap can't land here, but
+                # the guard is status-based like _prefill_phase's): its
+                # verify rows are dead — the readmission recomputes, and
+                # walking the reset tree would index an empty list
+                continue
             # accept walk from the root (greedy or vs the sampled tokens)
             ni = 0
             accepted_nodes = [0]
@@ -525,35 +648,162 @@ class SpecInferManager(RequestManager):
             if self.telemetry.enabled and len(req.tree) > 1:
                 self.telemetry.spec_acceptance(
                     len(accepted_nodes) - 1, len(req.tree) - 1)
-            # SSM needs the same accepted tokens in its committed cache; the
-            # root (generated[-1] pre-walk) is part of them
-            base_pos = req.ssm_committed
-            acc_toks = [req.tree[i].token for i in accepted_nodes]
-            req.ssm_backlog += [
-                (t, base_pos + k) for k, t in enumerate(acc_toks)
-            ]
+            # SSM needs the same accepted tokens in its committed cache;
+            # the root (generated[-1] pre-walk) is part of them.  Plain
+            # rows skip the draft model entirely — a later flip-on
+            # rebuilds the feed from scratch (``_on_spec_flip``), so
+            # their backlog must not accumulate unconsumed entries.
+            if req.spec:
+                base_pos = req.ssm_committed + len(req.ssm_backlog)
+                acc_toks = [req.tree[i].token for i in accepted_nodes]
+                req.ssm_backlog += [
+                    (t, base_pos + k) for k, t in enumerate(acc_toks)
+                ]
             for t in new_tokens:
                 self._append_token(req, t)
                 self._maybe_finish(req)
                 if req.status is RequestStatus.COMPLETED:
                     break
 
+    def _verify_sample(self, verifying: List[SpecRequest], index_of):
+        """Per-row sampling arg for the verify step: row ``index_of[(rid,
+        ni)]`` folds ``(rid, len(generated) + depth(ni))`` — the exact key
+        the incremental loop uses for that generated-token index, so
+        sampled speculative output is bit-identical to sampled incremental
+        decoding (rows of non-verifying slots draw from the (0, 0) fold
+        and are discarded).  Assembled by the ONE ``_sample_for`` path
+        (the tree depth rides the per-point index offset).  None for
+        greedy — checked HERE too so the point list (which indexes each
+        row's tree) is never built eagerly; rows whose request left
+        DECODING between list build and this call (page-pressure
+        preemption in ``_kv_prepare`` resets the tree) are skipped like
+        the accept walk skips them."""
+        if self.gen.temperature <= 0.0:
+            return None
+        return self._sample_for(
+            [(row, rid, self.requests[rid].tree[ni].depth)
+             for (rid, ni), row in index_of.items()
+             if self.requests[rid].status is RequestStatus.DECODING],
+            self.llm.max_tokens)
+
+    # ------------------------------------------------------------------
+    # the spec-aware tick: mixed macro-step, or the incremental fast path
+    # ------------------------------------------------------------------
+    def _spec_live(self) -> bool:
+        """Any ACTIVE (slotted) request in spec mode — the per-tick
+        dispatch decision.  Deliberately ignores the pending queue: a
+        spec arrival stuck behind a full house of plain decoders must not
+        force everyone onto the macro-step path (1 token/row/dispatch)
+        while it waits — the incremental fast path keeps serving, the
+        arrival admits through it, and the NEXT tick's check sees the
+        active spec row (its SSM cache lazily resyncs via
+        :meth:`_ssm_sync`, so incremental prefill/decode ticks before
+        activation are fine)."""
+        return any(r.spec for r in self._active())
+
+    def _ssm_sync(self, req: SpecRequest) -> None:
+        """Ensure the draft model's catch-up feed covers every position
+        before the next draft root (``seq_len - 1``).  A spec-mode row
+        can reach the macro path with a LAGGING SSM side — runtime
+        flip-on, or LLM prefill/decode ticks served by the incremental
+        fast path while the row waited to activate — in which case the
+        feed rebuilds from scratch (value-deterministic overwrite).
+        Steady-state rows (committed + backlog already reach the root)
+        are untouched."""
+        if req.status is not RequestStatus.DECODING:
+            return
+        want = req.seq_len - 1
+        if req.ssm_committed + len(req.ssm_backlog) >= want:
+            return
+        req.ssm_committed = 0
+        req.ssm_backlog = [
+            (self._token_at(req, p), p)
+            for p in range(len(req.prefill_tokens), want)
+        ]
+
+    def _flush_commits(self) -> bool:
+        """Exit-speculation commit flush: accepted-but-uncommitted tokens
+        (``pending_commit``) normally reach the committed cache through
+        the NEXT verify step's commit descriptor — when the tick degrades
+        to the incremental path (no live spec request) there is no next
+        verify step, so the pending positions are re-fed as one plain
+        batch instead (KV writes are value-deterministic, so recomputing
+        them equals the descriptor's spec-buffer copy bit-for-bit).  The
+        incremental step that follows then sees the complete cache
+        prefix.  Runs only at speculative→incremental transitions.
+
+        Returns whether the flush COMPLETED: a dispatch fault past the
+        retry budget requeues/fails only the rows in the failed batch,
+        but rows budget-deferred to a later inner batch still hold
+        un-flushed commits — the caller must not run an incremental step
+        over their incomplete cache prefix (the next tick retries)."""
+        flush = [r for r in self._active()
+                 if r.status is RequestStatus.DECODING and r.pending_commit]
+        if not flush:
+            return True
+        while True:
+            toks, reqi, pos, spans = [], [], [], []
+            budget = self.llm.max_tokens
+            for req in flush:
+                if not req.pending_commit or budget <= 0:
+                    continue
+                take = min(budget, len(req.pending_commit))
+                part = req.pending_commit[:take]
+                req.pending_commit = req.pending_commit[take:]
+                for _, dst in part:
+                    toks.append(self._token_at(req, dst))
+                    reqi.append(req.slot)
+                    pos.append(dst)
+                dsts = [d for _, d in part]
+                spans.append((req.rid, min(dsts), max(dsts) + 1))
+                budget -= take
+            if not toks:
+                break
+            self._kv_prepare(spans)
+            bc = self._plain_bc(self.llm, toks, reqi, pos)
+            # a flush fault past the retry budget affects only the rows
+            # actually IN the failed batch (a budget-limited flush may
+            # have deferred other rows to a later inner batch)
+            if self._guarded("spec_commit_flush",
+                             lambda b=bc: self.llm.step(b),
+                             affected_fn=lambda b=bc:
+                             self._rids_in_batch(b)) is None:
+                return False
+            self.llm_steps += 1
+        return True
+
+    def _tick(self) -> None:
+        """One serving tick: a mixed speculative macro-step while any
+        live request is in spec mode (plain rows ride the same verify
+        batch as root-only trees), otherwise — after flushing any
+        pending spec commits — the inherited incremental fast path
+        (decode stretches/scans included), so an all-plain population
+        never pays the macro-step overhead.  Lifecycle reaping, KV sync,
+        and plan-health polling stay in the shared serve loops
+        (``serve_incr_decoding`` / ``serve_with_arrivals``), so
+        deadlines/TTL/cancel land at spec macro-step boundaries exactly
+        like the incremental loop's step boundaries."""
+        if self._spec_live():
+            with self.telemetry.span("spec_macro_step", cat="spec",
+                                     track="spec"):
+                self._prefill_phase()
+                verifying = self._draft_phase()
+                self._verify_phase(verifying)
+            self.macro_steps += 1
+        else:
+            if self._flush_commits():
+                self._serve_tick()
+
     # ------------------------------------------------------------------
     def serve_spec_infer(self) -> Dict[int, List[int]]:
         """Reference: ``RequestManager::serve_spec_infer``.
 
-        Cancellations and deadline expiries are reaped at macro-step
-        boundaries (the speculative analogue of the incremental loop's
-        step-boundary checks)."""
-        while True:
-            self._check_lifecycle()
-            if not self.has_work():
-                break
-            self._prefill_phase()
-            drafting = self._draft_phase()
-            self._verify_phase(drafting)
-            self._sync_kv()  # live KV occupancy, once per macro step
-            self.macro_steps += 1
-        return {rid: r.generated for rid, r in self.requests.items()}
+        Now literally the inherited serve loop: the spec-aware
+        :meth:`_tick` is the only specialization, so cancellations,
+        deadline expiries, admission control, and plan-health polling are
+        ONE implementation across incremental and speculative serving —
+        reaped at macro-step boundaries (the speculative analogue of the
+        incremental loop's step-boundary checks)."""
+        return self.serve_incr_decoding()
 
     _serve = serve_spec_infer

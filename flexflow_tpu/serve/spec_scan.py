@@ -133,15 +133,24 @@ class SpecDecodeScan:
         )
 
     # ------------------------------------------------------------------
-    def init_carry(self, root_tokens, llm_committed, ssm_committed, finished):
+    def init_carry(self, root_tokens, llm_committed, ssm_committed, finished,
+                   spec_mask=None):
         """Build the scan carry from host bookkeeping (post-prefill).
 
         ``root_tokens[r]``: last generated token per slot (the tree root);
         ``llm_committed``/``ssm_committed``: committed cache depths (equal
         for active slots at macro-step boundaries); ``finished``: frozen
-        slots (emit nothing, write nothing).
+        slots (emit nothing, write nothing); ``spec_mask[r]`` (default
+        all-True): per-slot speculation mode — False rows skip drafting
+        and verify a ROOT-ONLY tree, i.e. they decode exactly one token
+        per macro step in the SAME batched verify as the spec rows (the
+        mixed spec/non-spec macro-step).  Plain rows still ride the
+        catch-up feed, so their SSM cache stays current and a host-side
+        flip between ``run()`` windows needs no rebuild.
         """
         R, D = self.llm.max_requests, self.depth
+        if spec_mask is None:
+            spec_mask = [True] * R
         return dict(
             llm_state=self.llm.state,
             ssm_state=self.ssm.state,
@@ -158,6 +167,7 @@ class SpecDecodeScan:
             backlog_tok=jnp.zeros((R, D + 1), jnp.int32),
             backlog_n=jnp.zeros((R,), jnp.int32),
             finished=jnp.asarray(finished, bool),
+            spec=jnp.asarray(spec_mask, bool),
         )
 
     def run(self, carry, n_macro: int, sample=None):
@@ -228,6 +238,7 @@ class SpecDecodeScan:
         R, W, D, P = (self.llm.max_requests, self.width, self.depth,
                       self.n_tree)
         fin = c["finished"]
+        smask = c["spec"]  # per-slot speculation mode (mixed macro-steps)
         slot = jnp.arange(R, dtype=jnp.int32)
         kk = jnp.arange(D + 1, dtype=jnp.int32)[None, :]          # [1, D+1]
 
@@ -266,8 +277,11 @@ class SpecDecodeScan:
                                     dtype=np.int32))
             F = len(f_idx)
             ftok = tok[:, f_idx]                                   # [R, F]
+            # non-spec rows never draft: their frontier tokens ship as
+            # padding (no KV writes, logits ignored) — the SSM step's
+            # shapes stay static, only the valid set shrinks
             reqi = jnp.broadcast_to(
-                jnp.where(fin, -1, slot)[:, None], (R, F))
+                jnp.where(fin | ~smask, -1, slot)[:, None], (R, F))
             fpos = jnp.broadcast_to(
                 (ssm_comm + lvl)[:, None], (R, F))
             spec = jnp.broadcast_to(jnp.asarray(f_idx)[None, :], (R, F))
@@ -307,7 +321,12 @@ class SpecDecodeScan:
         # ---- 3. LLM verify (commit descriptor from previous macro) ----
         cap_l = R * P  # exact: the verify batch is always R full trees
         depth_of = jnp.asarray(self._node_depth)                   # [P]
-        reqi_v = jnp.broadcast_to(jnp.where(fin, -1, slot)[:, None], (R, P))
+        # the MIXED verify batch: spec rows ship their whole tree, plain
+        # rows ship the root node only (their decode token) — nodes past
+        # the root become padding for non-spec slots
+        node_ok = smask[:, None] | (jnp.arange(P) == 0)[None, :]   # [R, P]
+        reqi_v = jnp.where(fin[:, None] | ~node_ok, -1,
+                           jnp.broadcast_to(slot[:, None], (R, P)))
         pos_v = c["llm_comm"][:, None] + depth_of[None, :]
         commit_valid = kk < jnp.where(fin, 0, c["commit_n"])[:, None]
         bc_v = TreeVerifyBatchConfig(
@@ -351,7 +370,10 @@ class SpecDecodeScan:
             ni, alive = wc                                         # [R], [R]
             want = jnp.take_along_axis(ids2, ni[:, None], 1)[:, 0]
             match = (par == ni[:, None]) & (tok == want[:, None])  # [R, P]
-            found = match.any(1) & alive
+            # non-spec rows accept no children (their tree arrays past the
+            # root hold unexpanded garbage): they emit exactly the bonus
+            # token per macro step — a plain decode in the shared batch
+            found = match.any(1) & alive & smask
             child = jnp.argmax(match, 1).astype(jnp.int32)
             emit = jnp.where(alive, want, -1)
             src = jnp.where(found, child, -1)
@@ -400,6 +422,7 @@ class SpecDecodeScan:
             backlog_tok=backlog_tok,
             backlog_n=jnp.where(cont, cnt, 0),
             finished=fin_new,
+            spec=smask,
         )
         return c2, e_out
 

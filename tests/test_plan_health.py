@@ -276,3 +276,61 @@ def test_arrivals_bit_identical_with_plan_health_layer():
     got = [recs1[rid]["tokens"] for rid in sorted(recs1)]
     assert got == want
     assert mon.checks > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance drift -> recommend flipping speculation off (ISSUE 11)
+# ---------------------------------------------------------------------------
+def test_acceptance_drift_recommends_non_spec_plan():
+    """Hermetic ISSUE 11 satellite: the incumbent is a SPEC plan searched
+    while the draft tracked the target (acceptance >> break-even); live
+    acceptance then degrades below break-even, the spec_acceptance
+    dimension's PSI crosses the drift threshold, and the monitor's
+    re-search on the LIVE profile recommends the NON-SPEC plan."""
+    import bench
+    from flexflow_tpu.search.serve_search import search_serve_plan
+
+    scen = bench.calibration_scenario()
+    ff, devices, mm = scen["ff"], scen["devices"], scen["mm_true"]
+    be = mm.spec.spec_break_even_acceptance
+
+    tel = Telemetry(clock=ManualClock(), workload_window=24)
+
+    def search_fn():
+        return search_serve_plan(
+            ff, n_chips=2, machine=mm, devices=devices, calibration=None,
+            workload=dict(scen["ref_feats"],
+                          mean_spec_acceptance=tel.workload.features()
+                          ["mean_spec_acceptance"]),
+            spec="auto")
+
+    depth = 3
+    # healthy phase: acceptance ~0.83 >> break-even -> spec incumbent
+    for _ in range(24):
+        tel.spec_acceptance(5, depth * 2)
+    incumbent = search_fn()
+    assert "_spec_" in incumbent["plan_key"], incumbent["plan_key"]
+
+    mon = PlanHealthMonitor(
+        tel, incumbent, reference=tel.workload.snapshot(),
+        config=PlanHealthConfig(drift_threshold=0.25, drift_min_samples=16,
+                                min_requests=1_000_000),
+        search_fn=search_fn)
+    healthy = mon.check()
+    assert healthy["healthy"]
+
+    # the draft stops tracking the target: acceptance collapses to ~0.17
+    for _ in range(24):
+        tel.spec_acceptance(1, depth * 2)
+    assert tel.workload.features()["mean_spec_acceptance"] < be
+    drifted = mon.check()
+    assert "workload_drift" in drifted["reasons"]
+    assert drifted["drift"]["per_dim"].get("spec_acceptance", 0.0) >= 0.25
+    assert drifted["replan_recommended"]
+    # the recommendation is the SAME tp x pp shape with speculation OFF
+    assert "_spec_" not in drifted["candidate"]["plan_key"]
+    assert mon.recommendation["incumbent"] == incumbent["plan_key"]
+    evs = [e for e in tel.trace.trace_events()
+           if e.get("name") == "replan_recommended"]
+    assert len(evs) == 1
+    assert "_spec_" not in evs[0]["args"]["candidate"]

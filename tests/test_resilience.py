@@ -449,9 +449,10 @@ def test_exhausted_retries_fail_mode_keeps_engine_alive():
 
 @pytest.mark.chaos
 def test_spec_infer_dispatch_faults_retry_to_bit_identity():
-    # the speculative macro-step's phase dispatches are guarded too; its
-    # failure mode is terminal (no recompute story), but retried faults
-    # within budget must leave the greedy spec == incremental invariant
+    # the speculative macro-step's phase dispatches are guarded too:
+    # retried faults within budget must leave the greedy spec ==
+    # incremental invariant (exhausted budgets recover via recompute —
+    # see the dedicated tests below)
     from flexflow_tpu.serve import SpecInferManager
     from test_spec_infer import TINY_SSM
 
@@ -495,3 +496,194 @@ def test_pp_stage_hop_faults_retry_to_bit_identity():
     got = rm.generate([prompt])[0]
     assert inj.injected == 2, "hop faults did not fire"
     assert got == want
+
+
+# ---------------------------------------------------------------------------
+# speculative serving: recompute recovery + lifecycle parity (ISSUE 11)
+# ---------------------------------------------------------------------------
+def spec_rig_for_chaos():
+    from test_spec_infer import TINY_SSM
+
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=TINY_SSM, topk=2, seed=123)
+    return llm, ssm
+
+
+@pytest.mark.chaos
+@pytest.mark.spec
+def test_spec_recompute_after_exhausted_retries_bit_identical():
+    """supports_recompute is now True for speculative serving: a fault
+    past the retry budget preempts the affected spec requests through the
+    r9 path (spec bookkeeping reset, prompt+generated re-prefilled into
+    BOTH models' caches) and the recomputed tokens are bit-identical."""
+    from flexflow_tpu.serve import SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=8)
+    llm, ssm = spec_rig_for_chaos()
+    want = RequestManager(llm, gen).generate(prompts)
+    llm.reset()
+    ssm.reset()
+    assert SpecInferManager.supports_recompute
+    inj = FaultInjector(seed=0, p=1.0, max_faults=2)  # 2 sure faults
+    sm = quiet(SpecInferManager(
+        llm, ssm, gen, width=2, depth=3, fault_injector=inj,
+        resilience=ResilienceConfig(
+            retry=RetryPolicy(max_retries=0),   # straight to requeue
+            on_dispatch_failure="requeue")))
+    got = sm.generate(prompts)
+    assert inj.injected == 2
+    assert got == want, "spec requeue-and-recompute diverged"
+    assert any(r.requeues >= 1 for r in sm.requests.values())
+    assert any(r.preemptions >= 1 for r in sm.requests.values())
+
+
+@pytest.mark.chaos
+@pytest.mark.spec
+def test_spec_recompute_bit_identical_seeded_sampling():
+    """Seeded sampling survives spec recompute bit-identically: the spec
+    phases key every sample on (rid, token_index), so the recomputed
+    trajectory replays the incremental loop's exactly."""
+    from flexflow_tpu.serve import SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=8, temperature=2.0, seed=11)
+    llm, ssm = spec_rig_for_chaos()
+    want = RequestManager(llm, gen).generate(prompts)
+    llm.reset()
+    ssm.reset()
+    # faults land mid-run at the LLM dispatch sites (seeded draw)
+    inj = FaultInjector(seed=1, p=0.4, max_faults=2)
+    sm = quiet(SpecInferManager(
+        llm, ssm, gen, width=2, depth=3, fault_injector=inj,
+        resilience=ResilienceConfig(
+            retry=RetryPolicy(max_retries=0),
+            on_dispatch_failure="requeue")))
+    got = sm.generate(prompts)
+    assert inj.injected == 2
+    assert got == want, "seeded spec recompute diverged"
+    assert any(r.requeues >= 1 for r in sm.requests.values())
+
+
+@pytest.mark.chaos
+@pytest.mark.spec
+def test_spec_chaos_all_terminal_with_recompute():
+    """Seeded chaos across every spec phase site: the engine never
+    crashes, every request ends terminal, and (retry budget exhausted →
+    requeue, bounded) survivors are bit-identical."""
+    from flexflow_tpu.serve import SpecInferManager, TERMINAL_STATUSES
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=6)
+    llm, ssm = spec_rig_for_chaos()
+    want = RequestManager(llm, gen).generate(prompts)
+    llm.reset()
+    ssm.reset()
+    tel = Telemetry()
+    inj = FaultInjector(seed=1, p=0.4, max_faults=3)
+    sm = quiet(SpecInferManager(
+        llm, ssm, gen, width=2, depth=3, telemetry=tel, fault_injector=inj,
+        resilience=ResilienceConfig(
+            retry=RetryPolicy(max_retries=1, backoff_s=0.0),
+            on_dispatch_failure="requeue", max_requeues=8)))
+    got = sm.generate(prompts)
+    assert inj.injected == 3, "seeded faults did not all fire"
+    assert all(r.status in TERMINAL_STATUSES for r in sm.requests.values())
+    # max_requeues ample + recompute bit-identity: every survivor matches
+    assert got == want, "spec chaos survivors diverged"
+
+
+@pytest.mark.spec
+def test_spec_cancel_mid_serve_other_requests_unchanged():
+    """Lifecycle parity (ISSUE 11 satellite): cancel(rid) reaps at spec
+    MACRO-STEP boundaries exactly like the incremental loop's step
+    boundaries — committed tokens kept, the other request's output
+    bit-identical to the no-cancel run."""
+    from flexflow_tpu.serve import SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=12)
+    llm, ssm = spec_rig_for_chaos()
+    want = RequestManager(llm, gen).generate(prompts)
+    llm.reset()
+    ssm.reset()
+    tel = Telemetry()
+    sm = quiet(SpecInferManager(llm, ssm, gen, width=2, depth=3,
+                                telemetry=tel))
+    arrivals = [(0.0, prompts[0], 12), (0.0, prompts[1], 12)]
+    clock = TriggerClock(
+        ready=lambda: 1 in sm.requests
+        and 1 <= len(sm.requests[1].generated) < 11,
+        fn=lambda: sm.cancel(1))
+    records = sm.serve_with_arrivals(arrivals, clock=clock)
+    assert clock.fired, "cancel trigger never armed"
+    assert records[1]["outcome"] == "cancelled"
+    assert 0 < len(records[1]["tokens"]) < 12
+    assert records[1]["tokens"] == want[1][: len(records[1]["tokens"])]
+    assert records[0]["outcome"] == "ok"
+    assert records[0]["tokens"] == want[0]
+    assert tel.metrics.counter("requests_cancelled").value == 1
+    # the cancelled request released BOTH deployments' attribution
+    assert not llm.kv.attributed_rids()
+    assert not ssm.kv.attributed_rids()
+
+
+@pytest.mark.spec
+def test_spec_ttl_timeout_reaped_at_macro_boundary():
+    """Deadline/TTL parity for spec serving: a queued request's TTL
+    expires while decode work runs and it terminates TIMED_OUT at a macro
+    boundary; the served requests are unaffected."""
+    from flexflow_tpu.serve import SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    llm, ssm = spec_rig_for_chaos()
+    want = RequestManager(llm, GenerationConfig(max_new_tokens=8)).generate(
+        prompts)
+    llm.reset()
+    ssm.reset()
+    tel = Telemetry()
+    sm = quiet(SpecInferManager(llm, ssm,
+                                GenerationConfig(max_new_tokens=8),
+                                width=2, depth=3, telemetry=tel))
+    # 3 arrivals into 2 slots; the third's TTL expires while it queues
+    arrivals = [
+        (0.0, prompts[0], 8),
+        (0.0, prompts[1], 8),
+        (0.0, [9, 1, 5], 8, {"ttl_s": 0.05}),
+    ]
+    records = sm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert records[2]["outcome"] == "timeout"
+    assert records[2]["tokens"] == []
+    assert records[0]["outcome"] == "ok" and records[0]["tokens"] == want[0]
+    assert records[1]["outcome"] == "ok" and records[1]["tokens"] == want[1]
+    assert tel.metrics.counter("requests_timeout").value == 1
+
+
+@pytest.mark.spec
+def test_spec_priority_preemption_now_supported():
+    """ResilienceConfig.preemption composes with speculative serving (the
+    r9 restriction is lifted): a higher-priority arrival evicts the
+    lowest-priority decoding spec request, which recomputes and still
+    finishes bit-identically."""
+    from flexflow_tpu.serve import SpecInferManager
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8], [33, 1, 60]]
+    llm, ssm = spec_rig_for_chaos()
+    want = RequestManager(llm, GenerationConfig(max_new_tokens=6)).generate(
+        prompts)
+    llm.reset()
+    ssm.reset()
+    # preemption config no longer raises
+    sm = quiet(SpecInferManager(
+        llm, ssm, GenerationConfig(max_new_tokens=6), width=2, depth=3,
+        resilience=ResilienceConfig(preemption=True)))
+    arrivals = [
+        (0.0, prompts[0], 6, {"priority": 0}),
+        (0.0, prompts[1], 6, {"priority": 0}),
+        (0.05, prompts[2], 6, {"priority": 5}),  # preempts a decoder
+    ]
+    records = sm.serve_with_arrivals(arrivals, clock=VirtualClock())
+    assert all(r["outcome"] == "ok" for r in records.values())
+    assert [records[i]["tokens"] for i in range(3)] == want
+    assert any(sm.requests[r].preemptions > 0 for r in sm.requests)

@@ -11,6 +11,7 @@ Gates:
 
 import jax
 import numpy as np
+import pytest
 
 from flexflow_tpu import FFConfig, FFModel
 from flexflow_tpu.core.pcg import PCG
@@ -236,3 +237,97 @@ def test_inference_manager_search_wires_calibration(monkeypatch):
     assert seen.get("memory_limit"), "no HBM memory_limit wired"
     assert seen["memory_limit"] == seen["machine"].spec.hbm_capacity
     assert seen.get("training") is False
+
+
+# ---------------------------------------------------------------------------
+# acceptance-aware speculative pricing (ISSUE 11)
+# ---------------------------------------------------------------------------
+@pytest.mark.spec
+def test_spec_pricing_flips_exactly_at_break_even():
+    """The measured break-even acceptance (BENCH r05, 0.439 — now the
+    calibratable ``TPUSpec.spec_break_even_acceptance`` constant) is THE
+    flip threshold: strictly above it the search returns a spec plan,
+    at or below it the incremental plan (speculation must earn its
+    machinery; ties keep non-spec)."""
+    from flexflow_tpu.search.serve_search import search_serve_plan
+
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff, _ = build_serve_model(mesh, max_seq=48, max_requests=2)
+    mm = _cpu_machine()
+    be = mm.spec.spec_break_even_acceptance
+    assert be == 0.439  # the BENCH r05 measurement, wired as a constant
+
+    def plan_at(acc):
+        return search_serve_plan(
+            ff, n_chips=1, machine=mm, calibration=None,
+            workload={"mean_prompt_len": 16.0, "mean_output_len": 32.0,
+                      "arrival_rate_per_s": 1.0, "mean_occupancy": 0.5,
+                      "mean_spec_acceptance": acc},
+            spec="auto")
+
+    above = plan_at(be + 0.01)
+    at = plan_at(be)
+    below = plan_at(be - 0.01)
+    assert above["plan_key"].endswith("_spec_w2d3"), above["plan_key"]
+    assert above["spec"]["break_even"] == be
+    assert above["tpot_ms"] < at["tpot_ms"]
+    assert "_spec_" not in at["plan_key"], "exact break-even must tie to non-spec"
+    assert at["spec"] is None
+    assert "_spec_" not in below["plan_key"]
+    # the threshold itself rides the plan for the dry-run section
+    assert above["spec_break_even"] == be == at["spec_break_even"]
+    # expected tokens/step = 1 + acceptance*depth (the SpecInfer commit
+    # arithmetic), and the spec TPOT is the base scaled by the factor
+    base = at["tpot_s"]  # unrounded
+    factor = (1 + be * 3) / (1 + (be + 0.01) * 3)
+    assert abs(above["tpot_s"] - base * factor) / base < 1e-9
+
+
+@pytest.mark.spec
+def test_spec_break_even_is_calibratable():
+    """A CalibrationStore component named ``spec_break_even_acceptance``
+    scales the constant like any machine time-constant (a machine whose
+    verify step runs relatively slower than modeled needs MORE acceptance
+    to break even), and ``with_calibration`` files override it."""
+    import json
+
+    from flexflow_tpu.search.machine_model import MachineModel
+
+    mm = _cpu_machine()
+
+    class FakeStore:
+        def scale_for(self, name):
+            return 1.5 if name == "spec_break_even_acceptance" else 1.0
+
+    scaled = mm.with_store(FakeStore())
+    assert scaled.spec.spec_break_even_acceptance == \
+        mm.spec.spec_break_even_acceptance * 1.5
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump({"spec_break_even_acceptance": 0.6}, f)
+        path = f.name
+    assert mm.with_calibration(path).spec.spec_break_even_acceptance == 0.6
+
+
+@pytest.mark.spec
+def test_price_plan_spec_parity_with_search():
+    """price_plan (the calibration replay side) prices a spec plan with
+    the SAME factor the chooser used — plan key and TPOT match."""
+    from flexflow_tpu.search.serve_search import price_plan, search_serve_plan
+
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff, _ = build_serve_model(mesh, max_seq=48, max_requests=2)
+    wl = {"mean_prompt_len": 16.0, "mean_output_len": 32.0,
+          "arrival_rate_per_s": 1.0, "mean_occupancy": 0.5,
+          "mean_spec_acceptance": 0.8}
+    best = search_serve_plan(ff, n_chips=1, machine=_cpu_machine(),
+                             calibration=None, workload=wl, spec="auto")
+    assert best["spec"] is not None
+    replay = price_plan(ff, best["tp"], best["pp"], best["n_micro"],
+                        machine=_cpu_machine(), workload=wl,
+                        spec={"width": 2, "depth": 3})
+    assert replay["plan_key"] == best["plan_key"]
+    assert abs(replay["tpot_ms"] - best["tpot_ms"]) < 1e-6

@@ -112,3 +112,285 @@ def test_capacity_validation():
                   cfg=TINY_SSM, topk=2, seed=1)
     with pytest.raises(ValueError):  # tree 1+2*3=7 > spec buffer 4
         SpecInferManager(llm, ssm, width=2, depth=3)
+
+
+# ---------------------------------------------------------------------------
+# mixed spec/non-spec continuous batching (the production-mode contract)
+# ---------------------------------------------------------------------------
+def committed_cache_row(im, slot, depth):
+    """The logical committed-KV prefix of one slot across every attention
+    buffer (k/v planes; int8 scales would ride along the same way)."""
+    import numpy as np
+
+    rows = {}
+    for name, bufs in im.state.items():
+        for buf, arr in bufs.items():
+            if buf.startswith(("k_cache", "v_cache")):
+                rows[f"{name}.{buf}"] = np.asarray(arr)[slot, :, :depth]
+    return rows
+
+
+@pytest.mark.spec
+def test_mixed_batch_bit_identical_greedy(incr_im, spec_rig):
+    """One mixed macro-step loop (spec + plain rows sharing the verify
+    batch) == each population served in its own loop — tokens AND the
+    logical committed caches (ISSUE 11 acceptance)."""
+    import numpy as np
+
+    want = incr_outputs(incr_im, n_new=10, prompts=PROMPTS[:2])
+    llm, ssm = spec_rig
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=10),
+                          width=2, depth=3)
+    r_spec = sm.register_new_request(PROMPTS[0], 10, spec=True)
+    r_plain = sm.register_new_request(PROMPTS[1], 10, spec=False)
+    out = sm.serve_spec_infer()
+    assert [out[r_spec], out[r_plain]] == want
+    assert sm.macro_steps > 0, "mixed run never speculated"
+    # slots were assigned in registration order (slot == rid here); the
+    # logical committed prefix is what the bit-identity contract covers
+    mixed_cache = {
+        rid: committed_cache_row(llm, rid, len(PROMPTS[rid]) + 10)
+        for rid in (r_spec, r_plain)
+    }
+
+    # population runs: the spec request alone in a spec loop, the plain
+    # request alone (same manager class, spec off) — both against the
+    # SAME rid so the sample-fold space matches
+    llm.reset()
+    ssm.reset()
+    sm_a = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=10),
+                            width=2, depth=3)
+    assert sm_a.register_new_request(PROMPTS[0], 10, spec=True) == 0
+    out_a = sm_a.serve_spec_infer()
+    assert out_a[0] == want[0]
+    cache_a = committed_cache_row(llm, 0, len(PROMPTS[0]) + 10)
+    for k in cache_a:
+        np.testing.assert_array_equal(
+            mixed_cache[r_spec][k], cache_a[k],
+            err_msg=f"spec row cache {k} diverged between mixed and "
+                    "population runs")
+
+    llm.reset()
+    ssm.reset()
+    sm_b = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=10),
+                            width=2, depth=3)
+    sm_b.register_new_request(PROMPTS[0], 0)  # burn rid 0 (completes now)
+    assert sm_b.register_new_request(PROMPTS[1], 10, spec=False) == 1
+    out_b = sm_b.serve_spec_infer()
+    assert out_b[1] == want[1]
+    assert sm_b.macro_steps == 0, "all-plain population paid the spec path"
+
+
+@pytest.mark.spec
+def test_mixed_batch_bit_identical_seeded(incr_im, spec_rig):
+    """Seeded sampling: the mixed run equals sampled INCREMENTAL decoding
+    per request (the (rid, token_index) fold makes every serving path —
+    incremental, spec, mixed — emit the same sampled trajectory)."""
+    gen = GenerationConfig(max_new_tokens=10, temperature=2.0, seed=11)
+    incr_im.reset()
+    want = RequestManager(incr_im, gen).generate(PROMPTS[:2])
+
+    llm, ssm = spec_rig
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, gen, width=2, depth=3)
+    r_spec = sm.register_new_request(PROMPTS[0], 10, spec=True)
+    r_plain = sm.register_new_request(PROMPTS[1], 10, spec=False)
+    out = sm.serve_spec_infer()
+    assert [out[r_spec], out[r_plain]] == want, \
+        "seeded mixed batch diverged from seeded incremental"
+
+    # and the all-spec population reproduces the same trajectories too
+    llm.reset()
+    ssm.reset()
+    sm2 = SpecInferManager(llm, ssm, gen, width=2, depth=3)
+    assert sm2.generate(PROMPTS[:2]) == want
+
+
+@pytest.mark.spec
+def test_spec_mode_flip_off_mid_serve(incr_im, spec_rig):
+    """Runtime spec→plain flip: pending commits flush, the tick degrades
+    to the incremental fast path, outputs stay bit-identical."""
+    want = incr_outputs(incr_im, n_new=10, prompts=PROMPTS[:2])
+    llm, ssm = spec_rig
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=10),
+                          width=2, depth=3)
+    rids = [sm.register_new_request(p, 10) for p in PROMPTS[:2]]
+    for _ in range(3):  # a few speculative macro steps
+        sm._check_lifecycle()
+        sm._tick()
+    assert any(sm.requests[r].pending_commit for r in rids)
+    for rid in rids:
+        assert sm.set_spec_mode(rid, False)
+    out = sm.serve_spec_infer()
+    assert [out[r] for r in rids] == want
+    assert not sm._spec_live()
+
+
+@pytest.mark.spec
+def test_spec_mode_flip_on_mid_serve(incr_im, spec_rig):
+    """Runtime plain→spec flip mid-decode: the SSM catch-up feed rebuilds
+    from scratch and the speculative tail is bit-identical."""
+    want = incr_outputs(incr_im, n_new=10, prompts=PROMPTS[:2])
+    llm, ssm = spec_rig
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=10),
+                          width=2, depth=3)
+    sm.scan_chunk = 1  # single-step incremental ticks: flip lands mid-decode
+    rids = [sm.register_new_request(p, 10, spec=False) for p in PROMPTS[:2]]
+    for _ in range(4):
+        sm._check_lifecycle()
+        if sm.has_work():
+            sm._tick()
+    assert all(0 < len(sm.requests[r].generated) < 10 for r in rids), \
+        "flip must land mid-generation"
+    for rid in rids:
+        assert sm.set_spec_mode(rid, True)
+    out = sm.serve_spec_infer()
+    assert [out[r] for r in rids] == want
+    assert sm.macro_steps > 0, "flip-on never speculated"
+
+
+@pytest.mark.spec
+def test_spec_serve_with_arrivals_mixed_modes():
+    """Speculation composes with the arrival loop: per-request ``spec``
+    arrival options, terminal outcomes, and output invariance to arrival
+    timing (continuous batching reorders work, never results)."""
+    from test_serving_under_load import VirtualClock
+
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=TINY_SSM, topk=2, seed=123)
+    want = incr_outputs(make_im(max_tokens=32, max_requests=2, max_seq=64),
+                        n_new=8, prompts=PROMPTS[:2])
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                          width=2, depth=3)
+    records = sm.serve_with_arrivals(
+        [(0.0, PROMPTS[0], 8, {"spec": True}),
+         (0.02, PROMPTS[1], 8, {"spec": False})],
+        clock=VirtualClock())
+    assert [records[0]["tokens"], records[1]["tokens"]] == want
+    assert all(r["outcome"] == "ok" for r in records.values())
+    assert sm.macro_steps > 0
+
+
+@pytest.mark.spec
+def test_queued_spec_arrival_keeps_plain_fast_path(incr_im, spec_rig):
+    """A spec arrival stuck behind a full house of plain decoders must
+    NOT drag the active rows onto the macro-step path while it queues:
+    the incremental fast path (decode stretches) keeps serving, the spec
+    request activates once a slot frees, its lazily-resynced SSM feed
+    catches up, and every output is bit-identical to incremental."""
+    prompts3 = PROMPTS[:3]
+    want = incr_outputs(incr_im, n_new=8, prompts=prompts3)
+    llm, ssm = spec_rig
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                          width=2, depth=3)
+    # two plain rows take both slots and start decoding
+    r0 = sm.register_new_request(prompts3[0], 8, spec=False)
+    r1 = sm.register_new_request(prompts3[1], 8, spec=False)
+    for _ in range(2):
+        sm._check_lifecycle()
+        sm._tick()
+    assert sm.macro_steps == 0
+    assert sm.scan_runs > 0, "plain rows should ride stretch fast paths"
+    gen_before = [len(sm.requests[r].generated) for r in (r0, r1)]
+    # a spec request arrives and queues (no free slot)
+    r2 = sm.register_new_request(prompts3[2], 8, spec=True)
+    sm._check_lifecycle()
+    sm._tick()
+    # the queued spec request must not force the macro path: the tick
+    # stays incremental (a single step here — pending arrivals cap the
+    # stretch quantum by design) and the plain rows keep decoding
+    assert sm.macro_steps == 0, "queued spec arrival dragged plain rows " \
+                                "onto the macro-step path"
+    assert [len(sm.requests[r].generated) for r in (r0, r1)] > gen_before
+    out = sm.serve_spec_infer()
+    assert [out[r0], out[r1], out[r2]] == want
+    assert sm.macro_steps > 0, "activated spec request never speculated"
+
+
+@pytest.mark.spec
+def test_verify_walk_survives_preemption_inside_kv_prepare(incr_im,
+                                                          spec_rig):
+    """Regression: page-pressure preemption inside _verify_phase's
+    ``_kv_prepare`` (paged pool exhaustion evicting a victim) resets the
+    victim's tree BETWEEN the verify list build and the accept walk — the
+    walk must skip the row (its emissions are dead; the readmission
+    recomputes) instead of indexing the empty tree, and the final outputs
+    stay bit-identical."""
+    from flexflow_tpu.serve import RequestStatus
+
+    want = incr_outputs(incr_im, n_new=8, prompts=PROMPTS[:2])
+    llm, ssm = spec_rig
+    llm.reset()
+    ssm.reset()
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                          width=2, depth=3)
+    orig = sm._kv_prepare
+    state = {"fired": False}
+
+    def paged_pressure(spans, kv=None):
+        # the LLM-side commit-span prepare of a verify round (kv=None,
+        # every active row decoding): evict a victim exactly where the
+        # paged allocator's PagePoolExhausted handling would
+        active = sm._active()
+        if (not state["fired"] and spans and kv is None and len(active) == 2
+                and all(r.status is RequestStatus.DECODING
+                        for r in active)):
+            state["fired"] = True
+            sm.preempt(active[0].rid)
+        return orig(spans, kv=kv)
+
+    sm._kv_prepare = paged_pressure
+    rids = [sm.register_new_request(p, 8) for p in PROMPTS[:2]]
+    out = sm.serve_spec_infer()
+    assert state["fired"], "the mid-verify preemption never landed"
+    assert any(sm.requests[r].preemptions > 0 for r in rids)
+    assert [out[r] for r in rids] == want
+
+
+@pytest.mark.spec
+@pytest.mark.slow
+@pytest.mark.paged
+def test_spec_pp2_paged_smoke():
+    """spec × paged-KV × pp2: the host spec manager drives a pipelined
+    target (tree-verify batches hop the live-cut boundary whole, spec
+    buffers per stage, one logical page table) with the draft co-resident
+    — greedy output == plain incremental decoding."""
+    import dataclasses
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.parallel.mesh import make_mesh
+    from flexflow_tpu.serve import PipelinedInferenceManager, build_model
+
+    from test_serve import TINY
+
+    want = incr_outputs(make_im(max_tokens=32, max_requests=2, max_seq=64),
+                        n_new=8, prompts=PROMPTS[:2])
+    mesh = jax.devices()[:2]
+    ff = FFModel(FFConfig(), mesh=make_mesh({"pp": 2}, mesh))
+    build_model(ff, TINY, 32)
+    llm = PipelinedInferenceManager(
+        ff, max_requests=2, max_tokens_per_batch=32, max_seq_len=64,
+        max_spec_tokens=8, use_pallas=False, kv_page_size=32)
+    llm.init_operators_inference(rng=jax.random.PRNGKey(7))
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=64, max_spec=8,
+                  cfg=TINY_SSM, topk=2, seed=123)
+    sm = SpecInferManager(llm, ssm, GenerationConfig(max_new_tokens=8),
+                          width=2, depth=3)
+    r0 = sm.register_new_request(PROMPTS[0], 8, spec=True)
+    r1 = sm.register_new_request(PROMPTS[1], 8, spec=False)
+    out = sm.serve_spec_infer()
+    assert [out[r0], out[r1]] == want

@@ -154,3 +154,39 @@ def test_scan_perfect_draft_commits_depth_plus_one():
     for r in range(2):
         got = [firsts[r]] + [int(t) for t in em[:, r].reshape(-1)]
         assert got == want[r][:13]
+
+
+@pytest.mark.spec
+def test_scan_mixed_spec_mask_matches_incremental():
+    """Mixed spec/non-spec rows in ONE on-device macro-step scan
+    (``init_carry(spec_mask=...)``): with a perfect draft (SSM == LLM)
+    the spec row commits depth+1 tokens per macro while the plain row in
+    the SAME verify batch commits exactly one — both bit-identical to
+    plain incremental decoding."""
+    im = make_im(max_tokens=32, max_requests=2, max_seq=96)
+    want = RequestManager(im, GenerationConfig(max_new_tokens=13)).generate(
+        PROMPTS)
+
+    llm = make_im(max_tokens=32, max_requests=2, max_seq=96, max_spec=8)
+    ssm = make_im(max_tokens=32, max_requests=2, max_seq=96, max_spec=8,
+                  topk=1)  # SSM == LLM: every spec-row chain drafts true
+    llm.tree_token_layout = None
+    firsts = prefill(llm, PROMPTS)
+    prefill(ssm, PROMPTS)
+    sc = SpecDecodeScan(llm, ssm, width=1, depth=3)
+    n_macro = 3
+    carry = sc.init_carry(
+        firsts, [len(p) for p in PROMPTS], [len(p) for p in PROMPTS],
+        [False] * len(PROMPTS), spec_mask=[True, False],
+    )
+    emitted, _ = sc.run(carry, n_macro)
+    em = np.asarray(emitted)
+    seq = [[firsts[r]] + [int(t) for t in em[:, r].reshape(-1) if t >= 0]
+           for r in range(2)]
+    # spec row: the perfect draft commits depth+1 = 4 per macro step
+    assert all(int((em[s, 0] >= 0).sum()) == 4 for s in range(n_macro))
+    assert seq[0] == want[0][: 1 + 4 * n_macro]
+    # plain row: EXACTLY one token per macro step, same trajectory
+    assert all(int((em[s, 1] >= 0).sum()) == 1 for s in range(n_macro))
+    assert len(seq[1]) == 1 + n_macro
+    assert seq[1] == want[1][: 1 + n_macro]

@@ -220,13 +220,45 @@ def test_dry_run_shared_prefix_exercises_page_pool_lifecycle(dryrun):
     assert reported["prefix_hits"] == sp["prefix_hits"]
 
 
+def test_dry_run_spec_serving_flips_at_break_even(dryrun):
+    """ISSUE 11 acceptance: the hermetic spec_serving section shows the
+    acceptance-aware planning decision — a spec plan above the measured
+    break-even acceptance, the incremental plan below it — plus the
+    runtime spec_mode_changed events and the mixed-batch composition
+    gauge riding the real telemetry schema."""
+    _, doc = dryrun
+    sp = doc["observability"]["spec_serving"]
+    be = sp["break_even_acceptance"]
+    assert be == 0.439  # BENCH r05, wired as the calibratable constant
+    hi, lo = sp["high_acceptance"], sp["low_acceptance"]
+    assert hi["mean_spec_acceptance"] > be > lo["mean_spec_acceptance"]
+    assert "_spec_" in hi["plan_key"] and hi["spec"]["acceptance"] > be
+    assert "_spec_" not in lo["plan_key"] and lo["spec"] is None
+    assert sp["flipped"]
+    # speculation is priced as a win only above break-even
+    assert hi["tpot_ms"] < lo["tpot_ms"]
+    # runtime events: 4 flips recorded, mix gauge exported
+    assert sp["spec_mode_changes"] == 4
+    assert len(sp["summary"]["spec_mode_changes"]) == 4
+    assert all(ev["spec"] is False
+               for ev in sp["summary"]["spec_mode_changes"])
+
+    # the CLI reproduces the summary from the JSONL alone
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         sp["paths"]["jsonl"]]))
+    assert reported["spec_mode_changes"] == \
+        sp["summary"]["spec_mode_changes"]
+
+
 def test_check_mode_validates_dry_run_schema(dryrun):
     out, doc = dryrun
     script = os.path.join(REPO, "scripts", "trace_report.py")
     for jsonl in (doc["observability"]["paths"]["jsonl"],
                   doc["observability"]["feedback_loop"]["paths"]["jsonl"],
                   doc["observability"]["memory_ledger"]["paths"]["jsonl"],
-                  doc["observability"]["shared_prefix"]["paths"]["jsonl"]):
+                  doc["observability"]["shared_prefix"]["paths"]["jsonl"],
+                  doc["observability"]["spec_serving"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
