@@ -13,12 +13,17 @@ profile vs the allocator's real headroom, breaching as
 binding constraint for serving, so running out of HBM is a plan-health
 failure exactly like missing an SLO.
 
-**Recommendation-only by design (this PR).**  The monitor never touches
-the executing engine: live migration needs the r9 preemption-and-recompute
-path to drain/move requests and rides a later PR.  Everything here is
-host-side arithmetic over the metrics registry and the workload profile —
-attaching a monitor cannot change serve outputs (bit-identity pinned in
-tests/test_plan_health.py, including a pp2 virtual-mesh config).
+**The monitor recommends; the MigrationController acts.**  Everything
+here is host-side arithmetic over the metrics registry and the workload
+profile — attaching a monitor cannot change serve outputs (bit-identity
+pinned in tests/test_plan_health.py, including a pp2 virtual-mesh
+config).  A :class:`~flexflow_tpu.serve.migration.MigrationController`
+attached to the serving RequestManager consumes ``recommendation``
+(which carries the full candidate plan dict) and executes the live plan
+switch over the r9 preemption-and-recompute path — drain/rebuild/
+readmit with rollback; see ``serve/migration.py``.  After a completed
+switch the controller calls :meth:`PlanHealthMonitor.rebase` so the
+monitor watches the NEW plan against a fresh reference window.
 """
 
 from __future__ import annotations
@@ -46,6 +51,14 @@ class PlanHealthConfig:
       the classic "population has shifted" line).
     * ``min_requests``: finished requests before latency checks engage —
       percentile comparisons over a handful of requests are noise.
+    * ``replan_cooldown_ticks``: checks that must pass after a
+      ``replan_recommended`` emission before ANOTHER may fire — the flap
+      guard.  The historical dedup is "once per distinct candidate",
+      which an OSCILLATING candidate pair defeats (A, B, A, B … each
+      differs from its predecessor, so every check emits); the cooldown
+      suppresses both the instant and the ``recommendation`` update for
+      that many checks, so a downstream MigrationController cannot be
+      whipsawed between two plans.  0 keeps the historical behavior.
     * ``memory_pressure_frac``: the OOM-risk line — breach when the
       PROJECTED live KV (current occupied positions + every live request
       growing by the workload profile's mean output length) exceeds this
@@ -63,6 +76,7 @@ class PlanHealthConfig:
     drift_threshold: float = 0.25
     drift_min_samples: int = 16
     min_requests: int = 8
+    replan_cooldown_ticks: int = 0
     memory_pressure_frac: float = 1.0
 
 
@@ -103,17 +117,44 @@ class PlanHealthMonitor:
         self.telemetry = telemetry_or_null(telemetry)
         self.plan = dict(plan)
         self.config = config or PlanHealthConfig()
+        self._reset_reference(reference)
+        self.search_fn = search_fn
+        self.kv_allocator = kv_allocator
+        self.checks = 0
+        self.recommendation: Optional[Dict] = None
+        self._last_candidate_key: Optional[str] = None
+        self._last_emit_check: Optional[int] = None
+        self._mem_pressure_active = False
+
+    def _reset_reference(self, reference) -> None:
+        """(Re)build the drift detector against ``reference`` (None = the
+        handle's CURRENT workload window) — shared by __init__ and
+        :meth:`rebase` so their wiring cannot diverge."""
         if reference is None and self.telemetry.enabled:
             reference = self.telemetry.workload.snapshot()
         self.detector = DriftDetector(
             reference or {"dims": {}},
             threshold=self.config.drift_threshold,
             min_samples=self.config.drift_min_samples)
-        self.search_fn = search_fn
-        self.kv_allocator = kv_allocator
-        self.checks = 0
-        self.recommendation: Optional[Dict] = None
-        self._last_candidate_key: Optional[str] = None
+
+    def rebase(self, plan: Dict, reference=None, kv_allocator=None) -> None:
+        """Re-point the monitor at a NEW executing plan (the
+        MigrationController calls this after a completed switch): the
+        candidate becomes the incumbent, the drift reference resets to
+        the CURRENT workload window (the plan was searched on the live
+        profile, so "planned-for" is exactly now), the stale
+        recommendation/dedup/edge-trigger/cooldown state clears (a NEW
+        plan's first recommendation must not be suppressed by the OLD
+        plan's emission window), and — when the rebuild swapped
+        allocators — the OOM-risk check re-wires to the new deployment's
+        caches."""
+        self.plan = dict(plan)
+        self._reset_reference(reference)
+        if kv_allocator is not None:
+            self.kv_allocator = kv_allocator
+        self.recommendation = None
+        self._last_candidate_key = None
+        self._last_emit_check = None
         self._mem_pressure_active = False
 
     # ------------------------------------------------------------------
@@ -249,23 +290,46 @@ class PlanHealthMonitor:
                     "ttft_ms": candidate.get("ttft_ms"),
                 }
                 if cand_key != plan_key:
-                    self.recommendation = {
-                        "incumbent": plan_key, "candidate": cand_key,
-                        "reasons": list(reasons),
-                        "candidate_tpot_ms": candidate.get("tpot_ms"),
-                        "drift_score": drift["score"],
-                    }
-                    report["replan_recommended"] = True
-                    if tel.enabled and cand_key != self._last_candidate_key:
-                        tel.instant(
-                            "replan_recommended", cat="plan",
-                            track="plan_health",
-                            incumbent=plan_key, candidate=cand_key,
-                            reasons=",".join(reasons),
-                            candidate_tpot_ms=candidate.get("tpot_ms"),
-                            drift_score=drift["score"])
-                        tel.metrics.counter("replans_recommended").inc()
-                    self._last_candidate_key = cand_key
+                    # flap guard (``replan_cooldown_ticks``): a NEW
+                    # candidate inside the cooldown window after the last
+                    # emission is suppressed entirely — no instant, no
+                    # ``recommendation`` update — so an oscillating
+                    # candidate pair cannot whipsaw a downstream
+                    # MigrationController (re-recommending the SAME
+                    # candidate stays allowed: it refreshes the payload
+                    # without emitting, the historical dedup)
+                    cooling = (cfg.replan_cooldown_ticks > 0
+                               and self._last_emit_check is not None
+                               and self.checks - self._last_emit_check
+                               < cfg.replan_cooldown_ticks
+                               and cand_key != self._last_candidate_key)
+                    if cooling:
+                        report["replan_suppressed"] = True
+                    else:
+                        self.recommendation = {
+                            "incumbent": plan_key, "candidate": cand_key,
+                            "reasons": list(reasons),
+                            "candidate_tpot_ms": candidate.get("tpot_ms"),
+                            "drift_score": drift["score"],
+                            # the full plan dict, so a MigrationController
+                            # can rebuild without re-running the search
+                            "candidate_plan": dict(candidate),
+                        }
+                        report["replan_recommended"] = True
+                        if cand_key != self._last_candidate_key:
+                            self._last_emit_check = self.checks
+                            if tel.enabled:
+                                tel.instant(
+                                    "replan_recommended", cat="plan",
+                                    track="plan_health",
+                                    incumbent=plan_key, candidate=cand_key,
+                                    reasons=",".join(reasons),
+                                    candidate_tpot_ms=candidate.get(
+                                        "tpot_ms"),
+                                    drift_score=drift["score"])
+                                tel.metrics.counter(
+                                    "replans_recommended").inc()
+                        self._last_candidate_key = cand_key
                 else:
                     report["incumbent_reaffirmed"] = True
         if not reasons:

@@ -45,6 +45,10 @@ _DRIFT = "drift_detected"
 _REPLAN = "replan_recommended"
 # memory observability (obs/memory.py): the OOM-risk breach instant
 _MEMPRESS = "memory_pressure"
+# live plan migration (serve/migration.py): the controller acting on
+# replan_recommended — start / completion / rollback of a plan switch
+_MIG_EVENTS = ("migration_started", "migration_completed",
+               "migration_rolled_back")
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -71,6 +75,7 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     drift_events: List[Dict] = []
     replans: List[Dict] = []
     mem_pressure: List[Dict] = []
+    migrations: Dict[str, List[Dict]] = {n: [] for n in _MIG_EVENTS}
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -105,6 +110,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _MEMPRESS:
             mem_pressure.append(ev.get("args", {}))
+            continue
+        if name in migrations:
+            migrations[name].append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -173,6 +181,12 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
         "replan_recommended": replans,
         # memory observability: OOM-risk breach instants (obs/plan_health.py)
         "memory_pressure": mem_pressure,
+        # live plan migration: started/completed/rolled_back event args
+        "migrations": {
+            "started": migrations["migration_started"],
+            "completed": migrations["migration_completed"],
+            "rolled_back": migrations["migration_rolled_back"],
+        },
     }
 
 
@@ -224,10 +238,17 @@ def summarize_jsonl(path: str) -> Dict:
     summary["applied_scales"] = store.get("applied_scales", {})
     # registry view of the resilience counters (the trace ring can drop
     # events under pressure; the counters are exact)
-    from .telemetry import RESILIENCE_COUNTERS
+    from .telemetry import MIGRATION_COUNTERS, RESILIENCE_COUNTERS
 
     summary["robustness"] = {
         k: metrics[k] for k in RESILIENCE_COUNTERS if k in metrics}
+    # registry view: migrations_completed/rolled_back are exact cumulative
+    # counters (survive trace-ring drops, like the resilience counters);
+    # the downtime/preempted entries are GAUGES carrying the LAST
+    # migration's values — per-migration history lives in the event lists
+    # above, not here
+    summary["migrations"]["counters"] = {
+        k: metrics[k] for k in MIGRATION_COUNTERS if k in metrics}
 
     pred_err: Dict[str, Dict] = {}
     for plan, fields in calibration.get("plans", {}).items():

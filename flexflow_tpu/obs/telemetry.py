@@ -85,7 +85,26 @@ EVENT_SCHEMA = {
     # request's speculation mode flipped at runtime (``set_spec_mode``) —
     # ``args.spec`` carries the new mode
     "spec_mode_changed": ("request", ("trace_id", "spec")),
+    # live plan migration (serve/migration.py): the MigrationController
+    # acting on ``replan_recommended`` (or an operator request) —
+    # started at the drain boundary; completed carries the preempted
+    # count + admission-closed downtime; rolled_back names the failed
+    # phase and the incumbent every request readmitted on
+    "migration_started": ("plan", ("incumbent", "candidate")),
+    "migration_completed": ("plan", ("incumbent", "candidate")),
+    "migration_rolled_back": ("plan", ("incumbent", "candidate")),
 }
+
+# migration counter/gauge vocabulary (report.py folds these into the
+# ``migrations`` summary section; the dry-run section and trace_report
+# share THIS tuple so a renamed metric cannot silently drop from either).
+# The first two are exact cumulative counters; the downtime/preempted
+# entries are gauges holding the LAST migration's values — per-migration
+# numbers ride the migration_completed event args
+MIGRATION_COUNTERS = (
+    "migrations_completed", "migrations_rolled_back",
+    "migration_downtime_ticks", "migration_preempted_requests",
+)
 
 
 class Telemetry:
@@ -262,6 +281,45 @@ class Telemetry:
         self.metrics.counter("spec_mode_changes").inc()
         return self.trace.instant("spec_mode_changed", "request", "requests",
                                   trace_id=trace_id, spec=bool(spec))
+
+    # ---- live plan migration (serve/migration.py) ---------------------
+    def migration_started(self, incumbent: str, candidate: str,
+                          reasons: str = "") -> float:
+        """A live plan switch began: admission is closed and the drain is
+        about to preempt the in-flight requests onto the recompute path."""
+        return self.trace.instant("migration_started", "plan", "migration",
+                                  incumbent=incumbent, candidate=candidate,
+                                  reasons=reasons)
+
+    def migration_completed(self, incumbent: str, candidate: str,
+                            mode: str = "rebuild",
+                            preempted_requests: int = 0,
+                            downtime_ticks: int = 0,
+                            downtime_s: Optional[float] = None) -> float:
+        """The candidate plan is serving: ``preempted_requests`` rode the
+        recompute path across the switch, ``downtime_ticks`` serve ticks
+        ran with admission closed (the drain grace window), and
+        ``mode="spec_flip"`` marks the rebuild-free fast path."""
+        m = self.metrics
+        m.counter("migrations_completed").inc()
+        m.gauge("migration_downtime_ticks").set(downtime_ticks)
+        m.gauge("migration_preempted_requests").set(preempted_requests)
+        return self.trace.instant(
+            "migration_completed", "plan", "migration",
+            incumbent=incumbent, candidate=candidate, mode=mode,
+            preempted_requests=preempted_requests,
+            downtime_ticks=downtime_ticks, downtime_s=downtime_s)
+
+    def migration_rolled_back(self, incumbent: str, candidate: str,
+                              phase: str = "", reason: str = "") -> float:
+        """The switch failed in ``phase`` (drain/rebuild/readmit):
+        admission reopened on the incumbent and every drained request
+        readmitted there — zero lost requests by contract."""
+        self.metrics.counter("migrations_rolled_back").inc()
+        return self.trace.instant(
+            "migration_rolled_back", "plan", "migration",
+            incumbent=incumbent, candidate=candidate, phase=phase,
+            reason=reason)
 
     def spec_batch_mix(self, spec_requests: int, plain_requests: int) -> None:
         """One mixed verify macro-step's request composition: how many
@@ -455,6 +513,15 @@ class NullTelemetry:
         return None
 
     def spec_mode_changed(self, *a, **k):
+        return 0.0
+
+    def migration_started(self, *a, **k):
+        return 0.0
+
+    def migration_completed(self, *a, **k):
+        return 0.0
+
+    def migration_rolled_back(self, *a, **k):
         return 0.0
 
     def spec_batch_mix(self, *a, **k):

@@ -45,6 +45,7 @@ from .resilience import (
     RetryPolicy,
     TransientServeError,
 )
+from .migration import MigrationConfig, MigrationController, MigrationRollback
 from .spec_infer import SpecInferManager
 from .api import LLM, SSM
 from .weights import convert_state_dict, load_hf_model, place_params
@@ -75,6 +76,9 @@ __all__ = [
     "InjectedFault",
     "TransientServeError",
     "SpecInferManager",
+    "MigrationController",
+    "MigrationConfig",
+    "MigrationRollback",
     "LLM",
     "SSM",
     "convert_state_dict",

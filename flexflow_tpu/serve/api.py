@@ -88,7 +88,8 @@ class LLM:
         RequestManager.  ``plan_health`` attaches a
         :class:`~flexflow_tpu.obs.PlanHealthMonitor` the serve loops poll
         (SLO / prediction-error / workload-drift checks emitting
-        ``replan_recommended`` — recommendation-only; see
+        ``replan_recommended``; pair it with :meth:`attach_migration` to
+        ACT on the recommendation via a live plan switch — see
         :meth:`health`)."""
         devices = devices if devices is not None else jax.devices()[:tp]
         mesh = make_mesh({"tp": tp}, devices)
@@ -147,13 +148,38 @@ class LLM:
         """Run (and return) one plan-health check NOW: live TTFT/TPOT vs
         the executing plan's predictions and SLO targets, plus workload
         drift vs the planned-for profile.  None when no monitor was
-        attached at :meth:`compile` time.  Recommendation-only — a
-        returned ``replan_recommended`` report names a candidate plan but
-        nothing migrates (that rides the r9 preemption path in a later
-        PR)."""
+        attached at :meth:`compile` time.  A ``replan_recommended``
+        report names a candidate plan; with a
+        :class:`~flexflow_tpu.serve.migration.MigrationController`
+        attached (:meth:`attach_migration`) the recommendation is ACTED
+        on — a live drain/rebuild/readmit plan switch over the r9
+        preemption-and-recompute path, with rollback — otherwise it is
+        report-only."""
         if self.rm is None or self.rm.plan_health is None:
             return None
         return self.rm.plan_health.check()
+
+    def attach_migration(self, build_manager, config=None, plan=None):
+        """Attach a live-migration controller to the serving session
+        (``serve/migration.py``): it consumes the plan-health monitor's
+        ``replan_recommended`` (and operator
+        :meth:`~flexflow_tpu.serve.migration.MigrationController.
+        request_migration` calls) and executes the plan switch at a serve
+        tick boundary — drain (admission closed + r9 preemption), rebuild
+        (``build_manager(candidate)`` constructs the new deployment),
+        readmit (rids preserved, token streams bit-identical), with
+        rollback to the incumbent on failure.  ``self.rm``/``self.im``
+        follow the active deployment across switches.  Returns the
+        controller."""
+        assert self.rm is not None, "call compile() first"
+        from .migration import MigrationController
+
+        def on_switch(new_rm):
+            self.rm = new_rm
+            self.im = new_rm.im
+
+        return MigrationController(self.rm, build_manager, plan=plan,
+                                   config=config, on_switch=on_switch)
 
     def memory_report(self):
         """The deployment's byte-side view NOW: the

@@ -391,3 +391,21 @@ class KVAllocator:
         """Rids currently holding attribution — empty once every request
         reached a terminal outcome (the no-leak contract)."""
         return sorted(set(self._live) | set(self._peak))
+
+    def teardown(self) -> List[int]:
+        """Release this deployment's cache ownership entirely: every
+        remaining per-request attribution releases, the watermarks reset,
+        and the buffers drop (``state = None`` per stage, freeing the
+        HBM).  THE incumbent-retirement hook of live plan migration
+        (serve/migration.py): after a full drain every request already
+        released on its slot-leaving path, so the returned list of rids
+        that STILL held attribution is the refcount no-leak check —
+        non-empty means some path leaked (pinned by
+        tests/test_migration.py / test_kv_paged.py)."""
+        leaked = self.attributed_rids()
+        for rid in leaked:
+            self.release(rid)
+        self.reset_attribution()
+        for s in self.stages:
+            s.state = None
+        return leaked

@@ -527,6 +527,17 @@ class PagedKVAllocator(KVAllocator):
                 self._unmap(slot, k)
         return super().release(rid, tokens)
 
+    def teardown(self):
+        """Base teardown (release attribution + drop buffers) PLUS a page
+        pool + prefix-index reset: unlike ``reset_attribution`` (same
+        buffers, index content still valid), the buffers are gone here,
+        so an index entry surviving would vouch for KV that no longer
+        exists — the migration-retirement analogue of ``allocate``'s
+        index invalidation."""
+        leaked = super().teardown()
+        self._init_pool()
+        return leaked
+
     # ---- capacity / headroom, page-granular ---------------------------
     @property
     def capacity_tokens(self) -> int:

@@ -199,8 +199,10 @@ class RequestManager:
         # ticks (and once when a serve loop drains) — host-side arithmetic
         # over the telemetry registry only, so attaching one can never
         # change serve outputs (tests/test_plan_health.py bit-identity).
-        # Recommendation-only: the monitor emits ``replan_recommended``;
-        # nothing here acts on it (live migration rides a later PR).
+        # The monitor emits ``replan_recommended``; an attached
+        # MigrationController (serve/migration.py) consumes it and
+        # executes the live plan switch at a tick boundary — without one,
+        # the recommendation is report-only.
         # The manager's KVAllocator is handed to the monitor so its
         # OOM-risk check prices projected KV growth against REAL headroom.
         self.plan_health = plan_health
@@ -208,6 +210,13 @@ class RequestManager:
                 and getattr(plan_health, "kv_allocator", None) is None):
             plan_health.kv_allocator = kv
         self._health_ticks = 0
+        # live plan migration (serve/migration.py): an attached
+        # MigrationController gets a tick-boundary slot via
+        # _maybe_migrate; while it drains the incumbent, admission to
+        # engine slots is closed (requests still enqueue — nothing new
+        # takes a slot) so the drain converges
+        self.migration = None
+        self.admission_closed = False
 
     @staticmethod
     def _fold_for(req: Request) -> Tuple[int, int]:
@@ -694,6 +703,11 @@ class RequestManager:
         return True
 
     def _admit(self):
+        if self.admission_closed:
+            # a migration drain is in progress: nothing new takes a slot
+            # (pending requests wait; they transplant to — or readmit
+            # after a rollback on — whichever manager serves next)
+            return
         self._fill_slots()
         if self.res.preemption:
             # bounded: each iteration either admits into a freed slot or
@@ -1187,6 +1201,12 @@ class RequestManager:
             return
         with tel.span("serve_step", cat="serve"):
             bc, sample_points = self.prepare_next_batch()
+            base = bc if isinstance(bc, BatchConfig) else bc.base
+            if int(np.asarray(base.num_tokens)) == 0:
+                # nothing slotted fed a token (admission closed during a
+                # migration drain with only pending work): dispatching an
+                # empty batch would burn a device step for nothing
+                return
             gated = (isinstance(bc, PrefillBatchConfig)
                      and bc.logit_slots is not None)
             smp = self._sample_for(
@@ -1330,7 +1350,20 @@ class RequestManager:
         if force or self._health_ticks % self.health_check_every == 0:
             self.plan_health.check()
 
-    def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8):
+    def _maybe_migrate(self, idle: bool = False):
+        """Tick-boundary slot for an attached
+        :class:`~flexflow_tpu.serve.migration.MigrationController`:
+        returns the SUCCESSOR manager when a live plan switch completed
+        at this boundary (the serve loops hand off to it mid-run), else
+        None.  ``idle`` = the loop has no work — a staged migration
+        executes immediately there (the zero-preemption window)."""
+        if self.migration is None:
+            return None
+        new_rm = self.migration.tick(self, idle=idle)
+        return new_rm if new_rm is not None and new_rm is not self else None
+
+    def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8,
+                            _t0=None, _records=None, _open=None):
         """Arrival-driven serving: requests join the running admit/retire
         loop at their offered times (open-loop load, the serving_under_load
         bench's engine).
@@ -1367,13 +1400,19 @@ class RequestManager:
         outputs are INVARIANT to arrival timing (continuous batching only
         reorders work, never results), pinned by
         tests/test_serving_under_load.py.
+
+        ``_t0``/``_records``/``_open`` are the live-migration continuation
+        (serve/migration.py): when a plan switch completes mid-loop, the
+        SUCCESSOR manager re-enters this method with the remaining
+        arrivals and the accumulated records/open set on the ORIGINAL
+        time base, so one arrival session spans managers seamlessly.
         """
         import time as _time
 
         clock = clock or _time.perf_counter
-        t0 = clock()
+        t0 = clock() if _t0 is None else _t0
         pending = sorted(arrivals, key=lambda a: a[0])
-        records: Dict[int, Dict] = {}
+        records: Dict[int, Dict] = {} if _records is None else _records
         saved_chunk = self.scan_chunk
         saved_clock = self._swap_clock(clock)  # rebases armed deadlines
         tel = self.telemetry
@@ -1382,7 +1421,7 @@ class RequestManager:
         # of the full (mostly-terminal) records history, so per-step host
         # work stays O(live) over long sessions (same contract as
         # _check_lifecycle)
-        open_rids: set = set()
+        open_rids: set = set() if _open is None else _open
 
         def admit_due():
             now = clock() - t0
@@ -1437,12 +1476,23 @@ class RequestManager:
                 if "finish_s" in rec:
                     open_rids.discard(rid)
 
+        def continue_on(new_rm):
+            # live migration completed at this boundary: the successor
+            # carries every request (rids preserved) — it re-enters this
+            # loop with the remaining arrivals on the original time base
+            return new_rm.serve_with_arrivals(
+                pending, clock=clock, quantum=quantum,
+                _t0=t0, _records=records, _open=open_rids)
+
         try:
             while pending or self.has_work():
                 now = admit_due()
                 self._check_lifecycle()
                 stamp(clock() - t0)
                 if not self.has_work():
+                    new_rm = self._maybe_migrate(idle=True)
+                    if new_rm is not None:
+                        return continue_on(new_rm)
                     # idle until the next arrival: a short bounded sleep for
                     # ANY clock — real clocks stop busy-spinning, virtual
                     # clocks (which advance per call) lose at most ~1ms of
@@ -1463,6 +1513,9 @@ class RequestManager:
                             tel.request_prefill_started(
                                 self.requests[rid].trace_id)
                 stamp(clock() - t0)
+                new_rm = self._maybe_migrate()
+                if new_rm is not None:
+                    return continue_on(new_rm)
             self._maybe_check_health(force=True)
         finally:
             self.scan_chunk = saved_chunk
@@ -1496,15 +1549,24 @@ class RequestManager:
         the per-step host path only handles admission/prefill boundaries.
         Cancellations and deadline expiries are reaped at every step
         boundary; transient dispatch faults retry-with-backoff and degrade
-        to requeue/fail of only the affected requests.
+        to requeue/fail of only the affected requests.  An attached
+        MigrationController (serve/migration.py) can swap the executing
+        plan at any tick boundary — the loop hands off to the successor
+        manager, which carries every request under its original rid.
         """
         while True:
             self._check_lifecycle()
             if not self.has_work():
+                new_rm = self._maybe_migrate(idle=True)
+                if new_rm is not None:
+                    return new_rm.serve_incr_decoding()
                 break
             self._tick()
             self._sync_kv()
             self._maybe_check_health()
+            new_rm = self._maybe_migrate()
+            if new_rm is not None:
+                return new_rm.serve_incr_decoding()
         self._maybe_check_health(force=True)
         return {rid: r.generated for rid, r in self.requests.items()}
 

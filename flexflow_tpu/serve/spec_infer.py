@@ -772,6 +772,17 @@ class SpecInferManager(RequestManager):
             self.llm_steps += 1
         return True
 
+    def flush_pending_commits(self) -> bool:
+        """Public drain hook (serve/migration.py): commit every
+        accepted-but-uncommitted token into the LLM cache NOW, so a
+        migration drain's grace window runs over a complete cache prefix
+        (the requests it then preempts recompute from scratch anyway —
+        their pending commits reset in :meth:`preempt` — but rows that
+        COMPLETE during the grace window must not finish on a cache
+        missing their accepted tail).  Same semantics as the
+        speculative→incremental transition flush."""
+        return self._flush_commits()
+
     def _tick(self) -> None:
         """One serving tick: a mixed speculative macro-step while any
         live request is in spec mode (plain rows ride the same verify

@@ -564,3 +564,41 @@ def test_workload_profile_tracks_shared_prefix_frac():
     assert snap["prefix_hits"] == 3
     assert snap["prefix_misses"] == 1
     assert snap["prefix_tokens_reused"] == 192
+
+
+@pytest.mark.migration
+def test_no_leak_across_manager_teardown_live_migration():
+    """ISSUE 12 satellite: migrating AWAY from a paged incumbent tears
+    its allocator down mid-flight with zero leaked refcounts — every
+    request-held page returns, the prefix index dies with the buffers
+    (their content is gone), and the pool is rebuildable."""
+    from flexflow_tpu.serve import MigrationConfig, MigrationController
+
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8], [9, 1, 5]]
+    gen = GenerationConfig(max_new_tokens=8)
+    imp = make_im(max_seq=64, kv_page_size=16)
+    want = RequestManager(imp, gen).generate(prompts)
+
+    imp = make_im(max_seq=64, kv_page_size=16)
+    rm = RequestManager(imp, gen)
+    rm.scan_chunk = 2
+    ctrl = MigrationController(
+        rm, lambda cand: make_im(max_seq=64),  # paged -> contiguous
+        plan={"plan_key": "tp1_pp1_m1_paged"},
+        config=MigrationConfig(defer_ticks=2, drain_grace_ticks=1))
+    ctrl.request_migration("tp1_pp1_m1")
+    got = rm.generate(prompts)
+    assert got == want, "paged -> contiguous switch diverged"
+    rec = ctrl.history[-1]
+    assert rec["outcome"] == "completed"
+    assert rec["preempted_requests"] > 0, "switch was not in-flight"
+    assert rec["kv_leaked_rids"] == []
+    kv = imp.kv
+    # the torn-down pool: no request refs, no index refs, buffers gone
+    assert kv.pages_held() == 0 and kv.attributed_rids() == []
+    assert int(kv._req_refs.sum()) == 0 and int(kv._idx_refs.sum()) == 0
+    assert len(kv._entries) == 0, "prefix index must not outlive buffers"
+    assert imp.state is None
+    assert len(kv._free) == kv.n_pages - 1, "pool must be fully rebuilt"
+    # the successor (contiguous) released everything on completion too
+    assert ctrl.rm.im.kv.attributed_rids() == []

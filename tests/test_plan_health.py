@@ -334,3 +334,92 @@ def test_acceptance_drift_recommends_non_spec_plan():
            if e.get("name") == "replan_recommended"]
     assert len(evs) == 1
     assert "_spec_" not in evs[0]["args"]["candidate"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12 satellite: the replan flap guard (replan_cooldown_ticks)
+# ---------------------------------------------------------------------------
+def test_oscillating_candidates_without_cooldown_emit_every_check():
+    """The historical dedup is once-per-DISTINCT-candidate: an A/B/A/B
+    oscillation defeats it (every check's candidate differs from the
+    last) — the baseline the cooldown knob exists to fix."""
+    tel = Telemetry(clock=ManualClock())
+    _warm(tel, tpot_s=0.005)
+    flip = {"n": 0}
+
+    def search_fn():
+        flip["n"] += 1
+        return _plan(key="plan_A" if flip["n"] % 2 else "plan_B")
+
+    mon = PlanHealthMonitor(
+        tel, _plan(tpot_ms=1.0), reference=tel.workload.snapshot(),
+        config=PlanHealthConfig(min_requests=5, max_tpot_error_frac=0.5),
+        search_fn=search_fn)
+    for _ in range(6):
+        mon.check()
+    evs = [e for e in tel.trace.trace_events()
+           if e.get("name") == "replan_recommended"]
+    assert len(evs) == 6, "without a cooldown every oscillation emits"
+
+
+def test_replan_cooldown_ticks_suppresses_flapping():
+    tel = Telemetry(clock=ManualClock())
+    _warm(tel, tpot_s=0.005)
+    flip = {"n": 0}
+
+    def search_fn():
+        flip["n"] += 1
+        return _plan(key="plan_A" if flip["n"] % 2 else "plan_B")
+
+    mon = PlanHealthMonitor(
+        tel, _plan(tpot_ms=1.0), reference=tel.workload.snapshot(),
+        config=PlanHealthConfig(min_requests=5, max_tpot_error_frac=0.5,
+                                replan_cooldown_ticks=10),
+        search_fn=search_fn)
+    reports = [mon.check() for _ in range(6)]
+    # one emission, then suppression: the recommendation payload stays
+    # pinned to the first candidate instead of whipsawing
+    assert reports[0]["replan_recommended"]
+    assert all(r.get("replan_suppressed") for r in reports[1::2]), \
+        "the oscillating candidate must be suppressed inside the window"
+    evs = [e for e in tel.trace.trace_events()
+           if e.get("name") == "replan_recommended"]
+    assert len(evs) == 1
+    assert tel.metrics.snapshot()["replans_recommended"] == 1
+    assert mon.recommendation["candidate"] == "plan_A"
+    # past the window a NEW candidate may emit again
+    for _ in range(6):
+        mon.check()
+    evs = [e for e in tel.trace.trace_events()
+           if e.get("name") == "replan_recommended"]
+    assert len(evs) == 2, "cooldown must expire, not silence forever"
+
+
+def test_rebase_repoints_monitor_at_new_plan():
+    """After a live migration the controller rebases the monitor: the
+    candidate becomes the incumbent, drift re-references the CURRENT
+    window, and stale recommendation/edge state clears."""
+    tel = Telemetry(clock=ManualClock(), workload_window=20)
+    _warm(tel, n=20, prompt_len=16)
+    mon = PlanHealthMonitor(
+        tel, _plan(tpot_ms=0.0001), reference=tel.workload.snapshot(),
+        config=PlanHealthConfig(min_requests=5, max_tpot_error_frac=0.01),
+        search_fn=lambda: _plan(key="tp2_pp1_m1", tpot_ms=5.0))
+    rep = mon.check()
+    assert rep["replan_recommended"]
+    assert mon.recommendation["candidate_plan"]["plan_key"] == "tp2_pp1_m1"
+
+    class FakeKV:  # allocator stand-in whose caches are unallocated
+        def bytes_per_token(self):
+            return None
+
+    fake = FakeKV()
+    _warm(tel, n=20, prompt_len=2048)  # the mix the NEW plan was priced for
+    mon.rebase({"plan_key": "tp2_pp1_m1", "tpot_ms": 5.0},
+               kv_allocator=fake)
+    assert mon.plan["plan_key"] == "tp2_pp1_m1"
+    assert mon.recommendation is None
+    assert mon.kv_allocator is fake
+    # the drifted window became the reference: no drift breach against it
+    rep = mon.check()
+    assert "workload_drift" not in rep["reasons"]

@@ -687,3 +687,128 @@ def test_spec_priority_preemption_now_supported():
     assert all(r["outcome"] == "ok" for r in records.values())
     assert [records[i]["tokens"] for i in range(3)] == want
     assert any(sm.requests[r].preemptions > 0 for r in sm.requests)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle racing a live-migration drain (ISSUE 12 satellite): a request
+# cancelled — or expiring — mid-migration must reach EXACTLY one terminal
+# outcome and release its KV on whichever manager holds it
+# ---------------------------------------------------------------------------
+def _migrating_rm(gen, tel=None, defer=1, grace=1):
+    from flexflow_tpu.serve import MigrationConfig, MigrationController
+
+    im = make_im(max_seq=64)
+    rm = quiet(RequestManager(im, gen, telemetry=tel))
+    rm.scan_chunk = 2
+    ctrl = MigrationController(
+        rm, lambda cand: make_im(max_seq=64, kv_page_size=16),
+        plan={"plan_key": "tp1_pp1_m1"},
+        config=MigrationConfig(defer_ticks=defer, drain_grace_ticks=grace))
+    ctrl.request_migration("tp1_pp1_m1_paged")
+    return im, rm, ctrl
+
+
+@pytest.mark.migration
+def test_cancel_racing_drain_exactly_one_terminal_outcome():
+    """The cancel flag is raised on the LAST tick before the switch, so
+    it transplants with the request and the SUCCESSOR manager reaps it —
+    one cancelled outcome, tokens a prefix of the uncancelled run, KV
+    released on both sides."""
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=12)
+    im0 = make_im(max_seq=64)
+    want = RequestManager(im0, gen).generate(prompts)
+
+    tel = Telemetry()
+    im, rm, ctrl = _migrating_rm(gen, tel=tel)
+    for p in prompts:
+        rm.register_new_request(p)
+    ticks = {"n": 0}
+    orig = rm._tick
+
+    def tick():
+        orig()
+        ticks["n"] += 1
+        if ticks["n"] == 3:  # the execute boundary follows this tick
+            rm.cancel(1)
+    rm._tick = tick
+    rm.serve_incr_decoding()
+    assert ctrl.history[-1]["outcome"] == "completed"
+    active = ctrl.rm
+    assert active is not rm, "the switch must have happened"
+    req = active.requests[1]
+    assert req.status is RequestStatus.CANCELLED
+    assert req.cancel_requested, "the flag must have crossed the transplant"
+    assert 0 < len(req.generated) < 12
+    assert req.generated == want[1][: len(req.generated)]
+    # exactly ONE terminal outcome was ever recorded for the rid
+    assert tel.metrics.counter("requests_cancelled").value == 1
+    assert active.requests[0].generated == want[0]
+    # KV released everywhere: incumbent tore down leak-free, successor's
+    # paged pool holds nothing
+    assert im.kv.attributed_rids() == []
+    assert active.im.kv.attributed_rids() == []
+    assert active.im.kv.pages_held() == 0
+
+
+@pytest.mark.migration
+def test_cancel_reaped_by_incumbent_during_grace_window():
+    """A cancel landing EARLY in the admission-closed grace window is
+    reaped by the incumbent before the drain — the terminal record
+    carries across the switch untouched (no resurrection, no double
+    outcome)."""
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=12)
+    tel = Telemetry()
+    im, rm, ctrl = _migrating_rm(gen, tel=tel, defer=1, grace=3)
+    for p in prompts:
+        rm.register_new_request(p)
+    ticks = {"n": 0}
+    orig = rm._tick
+
+    def tick():
+        orig()
+        ticks["n"] += 1
+        if ticks["n"] == 2:  # inside the grace window, pre-drain
+            rm.cancel(0)
+    rm._tick = tick
+    rm.serve_incr_decoding()
+    assert ctrl.history[-1]["outcome"] == "completed"
+    active = ctrl.rm
+    req = active.requests[0]
+    assert req.status is RequestStatus.CANCELLED
+    assert req is rm.requests[0], \
+        "a pre-drain terminal record must carry over as-is"
+    assert tel.metrics.counter("requests_cancelled").value == 1
+    assert active.requests[1].status is RequestStatus.COMPLETED
+    assert im.kv.attributed_rids() == []
+    assert active.im.kv.attributed_rids() == []
+
+
+@pytest.mark.migration
+def test_deadline_expiry_racing_drain_exactly_one_terminal_outcome():
+    """A TTL armed before the switch expires AFTER the transplant: the
+    successor manager's lifecycle check times the request out — once —
+    and releases its pages; the survivor finishes bit-identically."""
+    prompts = [[3, 11, 25, 40, 7], [2, 4, 6, 8]]
+    gen = GenerationConfig(max_new_tokens=12)
+    im0 = make_im(max_seq=64)
+    want = RequestManager(im0, gen).generate(prompts)
+
+    tel = Telemetry()
+    im, rm, ctrl = _migrating_rm(gen, tel=tel)
+    rm.clock = VirtualClock()  # deterministic deadline clock
+    rm.register_new_request(prompts[0])
+    rm.register_new_request(prompts[1], ttl_s=0.08)
+    rm.serve_incr_decoding()
+    assert ctrl.history[-1]["outcome"] == "completed"
+    active = ctrl.rm
+    req = active.requests[1]
+    assert req.status is RequestStatus.TIMED_OUT
+    assert len(req.generated) < 12, "the TTL must have cut the request"
+    assert req.generated == want[1][: len(req.generated)]
+    assert tel.metrics.counter("requests_timeout").value == 1
+    assert active.requests[0].generated == want[0]
+    assert im.kv.attributed_rids() == []
+    assert active.im.kv.attributed_rids() == []
+    assert active.im.kv.pages_held() == 0

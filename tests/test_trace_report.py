@@ -251,6 +251,43 @@ def test_dry_run_spec_serving_flips_at_break_even(dryrun):
         sp["summary"]["spec_mode_changes"]
 
 
+def test_dry_run_live_migration_roundtrips(dryrun):
+    """ISSUE 12 acceptance: the hermetic live_migration section records a
+    REAL mid-flight plan switch — migration downtime (serve ticks with
+    admission closed) and the preempted-request count — plus one forced
+    rollback, all riding the real schema and reproduced by the CLI."""
+    _, doc = dryrun
+    lm = doc["observability"]["live_migration"]
+    assert lm["bit_identical"], "tokens diverged across the dry-run switch"
+    mig = lm["migration"]
+    assert mig["preempted_requests"] >= 1, "the switch was not in-flight"
+    assert mig["downtime_ticks"] >= 1
+    assert mig["downtime_s"] > 0
+    assert mig["kv_leak_free"]
+    assert mig["candidate"] == "tp1_pp1_m1_paged"
+    assert lm["rollback"]["phase"] == "rebuild"
+    assert lm["rollback"]["requests_recovered_on_incumbent"]
+    assert lm["migrations_completed"] == 1
+    assert lm["migrations_rolled_back"] == 1
+
+    s = lm["summary"]
+    migs = s["migrations"]
+    assert len(migs["started"]) == 2
+    [done] = migs["completed"]
+    assert done["preempted_requests"] == mig["preempted_requests"]
+    assert done["downtime_ticks"] == mig["downtime_ticks"]
+    [rolled] = migs["rolled_back"]
+    assert rolled["phase"] == "rebuild" and "RuntimeError" in rolled["reason"]
+    assert migs["counters"]["migrations_completed"] == 1
+    assert migs["counters"]["migrations_rolled_back"] == 1
+
+    # the CLI reproduces the summary from the JSONL alone
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         lm["paths"]["jsonl"]]))
+    assert reported == s, "trace_report.py diverged on migration events"
+
+
 def test_check_mode_validates_dry_run_schema(dryrun):
     out, doc = dryrun
     script = os.path.join(REPO, "scripts", "trace_report.py")
@@ -258,7 +295,8 @@ def test_check_mode_validates_dry_run_schema(dryrun):
                   doc["observability"]["feedback_loop"]["paths"]["jsonl"],
                   doc["observability"]["memory_ledger"]["paths"]["jsonl"],
                   doc["observability"]["shared_prefix"]["paths"]["jsonl"],
-                  doc["observability"]["spec_serving"]["paths"]["jsonl"]):
+                  doc["observability"]["spec_serving"]["paths"]["jsonl"],
+                  doc["observability"]["live_migration"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
