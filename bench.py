@@ -829,10 +829,16 @@ def bench_serving_under_load(pallas_tpot, ctx=256, max_new=32, n_req=24,
                 arrivals.append((t, prompt, max_new))
             im.reset()
             tel = Telemetry()
+            from flexflow_tpu.obs import StepProfiler
+
+            prof = StepProfiler()
             rm = RequestManager(im, GenerationConfig(max_new_tokens=max_new),
-                                telemetry=tel)
+                                telemetry=tel, profiler=prof)
             t0 = time.perf_counter()
             records = rm.serve_with_arrivals(arrivals)
+            # records carry per-request deterministic work counters, so
+            # under_load_metrics emits the "work" totals bench_compare
+            # diffs even with no device attached (obs/profiler.py)
             metrics = under_load_metrics(records)
             metrics["wall_s"] = round(time.perf_counter() - t0, 2)
             metrics["offered_rps"] = round(rate, 3)
@@ -846,6 +852,15 @@ def bench_serving_under_load(pallas_tpot, ctx=256, max_new=32, n_req=24,
                     "decode_scan_steps", "requests_finished")
                 if k in snap}
             metrics["trace_events"] = tel.trace.emitted
+            # step-level attribution: the phase time budget + the exact
+            # recompile/host-sync guards for this load point
+            p = prof.report()
+            metrics["step_profile"] = {
+                "phases": p["phases"],
+                "recompiles_total": p["work"]["recompiles_total"],
+                "host_syncs": p["work"]["host_syncs"],
+                "dispatches": p["work"]["dispatches"],
+            }
             out["offered_loads_rps"][label] = metrics
             tel.export(os.path.join("artifacts", "telemetry"),
                        prefix=f"under_load_{label}")
@@ -1938,6 +1953,142 @@ def live_migration_dryrun(out_dir=None):
     }
 
 
+def step_profile_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` step-level cost attribution section
+    (obs/profiler.py) — two demonstrations, no device work:
+
+    * **per-component reconciliation** — the serve pricing is decomposed
+      into the shared component vocabulary (attention / mlp / lm_head /
+      kv_stream / comms / hop / host_overhead); a machine model whose
+      HOP is mispriced 2.5x (ici bandwidth AND latency) produces
+      predicted/measured component pairs whose ledger
+      ``suggested_scale`` isolates the skew to ``hop_ms`` alone, the
+      scale commits into a CalibrationStore, and a replayed pricing
+      with the store's component scales corrects ONLY the hop
+      (``error_frac`` drops below 0.1 for the skewed component, the
+      others unchanged) — the acceptance demonstration that
+      whole-plan calibration cannot do;
+    * **a REAL tiny profiled serve** — a StepProfiler threaded through
+      a RequestManager on a virtual clock: phase time budget
+      (host_prepare / dispatch / readback), deterministic work counters
+      (flops, KV bytes touched, dispatches, recompiles, host syncs),
+      per-request attribution, and token BIT-IDENTITY vs the
+      profiler-off run — exported through the real telemetry schema
+      (``step_profile`` instants + the ``profile`` JSONL line) and
+      round-tripped through ``scripts/trace_report.py`` (its
+      ``time_budget`` section; tests/test_trace_report.py pins it).
+
+    The exported artifact is also the reference input for
+    ``scripts/bench_compare.py`` — deterministic counters compare
+    exactly across runs, so a counter regression is catchable with no
+    device attached.
+    """
+    import dataclasses
+    import os
+
+    from flexflow_tpu.obs import CalibrationStore, StepProfiler, StoreConfig, Telemetry
+    from flexflow_tpu.obs.profiler import TIME_COMPONENT_FIELDS
+    from flexflow_tpu.obs.report import summarize_jsonl
+    from flexflow_tpu.search.machine_model import MachineModel
+    from flexflow_tpu.search.serve_search import (
+        price_plan,
+        search_serve_plan,
+        store_component_scales,
+    )
+    from flexflow_tpu.serve import GenerationConfig, RequestManager
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    clock = _Tick()
+    tel = Telemetry(clock=clock)
+
+    # ---- per-component reconciliation (hop mispriced 2.5x) --------------
+    scen = calibration_scenario()
+    ff, devices = scen["ff"], scen["devices"]
+    mm_model = scen["mm_true"]          # what the planner believes
+    hop_skew = 2.5
+    mm_device = MachineModel(dataclasses.replace(
+        mm_model.spec,
+        ici_bandwidth=mm_model.spec.ici_bandwidth / hop_skew,
+        ici_latency=mm_model.spec.ici_latency * hop_skew))
+
+    store_path = os.path.join(out_dir, "component_store.json")
+    store = CalibrationStore(store_path, StoreConfig(min_samples=2))
+    meas_by_key = {}
+    for m in (1, 2):   # two plan keys so every component clears the gate
+        key = f"tp1_pp2_m{m}"
+        pred = price_plan(ff, 1, 2, m, machine=mm_model, devices=devices)
+        tel.record_plan_prediction(key, tpot_ms=pred["tpot_ms"],
+                                   **pred["components"])
+        meas = price_plan(ff, 1, 2, m, machine=mm_device, devices=devices)
+        tel.record_plan_measured(key, tpot_ms=meas["tpot_ms"],
+                                 **meas["components"])
+        meas_by_key[key] = meas
+    report = tel.calibration.report()
+    tel.calibration.commit(store)
+    store.save()
+    tel.store = store
+
+    def comp_errors(pred_components, meas_components):
+        return {
+            c: round((pred_components[c] - meas_components[c])
+                     / meas_components[c], 4)
+            for c in pred_components if meas_components.get(c)}
+
+    pred1 = price_plan(ff, 1, 2, 1, machine=mm_model, devices=devices)
+    err_before = comp_errors(pred1["components"],
+                             meas_by_key["tp1_pp2_m1"]["components"])
+    pred2 = price_plan(ff, 1, 2, 1, machine=mm_model, devices=devices,
+                       component_scales=store_component_scales(store))
+    err_after = comp_errors(pred2["components"],
+                            meas_by_key["tp1_pp2_m1"]["components"])
+    # ...and search_serve_plan consults the same component scales
+    # automatically through the calibration store
+    searched = search_serve_plan(ff, n_chips=2, machine=mm_model,
+                                 devices=devices, calibration=store)
+
+    # ---- a REAL tiny profiled serve -------------------------------------
+    prompts = [[3, 5, 7, 9, 11], [2, 4, 6], [13, 8]]
+    gen = GenerationConfig(max_new_tokens=8)
+
+    def tiny_im():
+        return build_im(False, layers=2, hidden=32, heads=2, kv=2, inter=48,
+                        vocab=64, max_requests=2, max_seq=64, max_tokens=16)
+
+    baseline = RequestManager(tiny_im(), gen).generate(prompts)
+    prof = StepProfiler(clock=clock)
+    rm = RequestManager(tiny_im(), gen, telemetry=tel, profiler=prof)
+    tokens = rm.generate(prompts)
+
+    paths = tel.export(out_dir, prefix="dryrun_step_profile")
+    summary = summarize_jsonl(paths["jsonl"])
+    prof_report = prof.report()
+    return {
+        "paths": paths,
+        "summary": summary,
+        "bit_identical": tokens == baseline,
+        "profiler": prof_report,
+        "reconciliation": {
+            "skewed_component": "hop_ms",
+            "hop_skew": hop_skew,
+            "suggested_scales": {
+                c: report["components"][c]["suggested_scale"]
+                for c in TIME_COMPONENT_FIELDS
+                if c in report["components"]},
+            "error_frac_before": err_before,
+            "error_frac_after": err_after,
+            "store_path": store_path,
+            "search_applied_scales": searched.get("applied_scales", {}),
+        },
+        "note": "hermetic: hop-mispriced machine -> per-component "
+                "predicted/measured pairs -> hop_ms suggested_scale 2.5 "
+                "-> store -> replay corrects ONLY the hop; plus a real "
+                "tiny serve profiled on a virtual clock (phase budget + "
+                "deterministic counters, tokens bit-identical to the "
+                "profiler-off run); counters are the bench_compare.py "
+                "guardrail fields",
+    }
+
+
 def bench_shared_prefix(ctx=256, n_users=16, shared_len=1536,
                         suffix_len=128, max_new=32, page=512):
     """DEVICE shared-prefix serving section: N users x one system prompt,
@@ -2015,6 +2166,7 @@ def main(argv=None):
         doc["observability"]["spec_serving"] = spec_serving_dryrun(args.out)
         doc["observability"]["live_migration"] = live_migration_dryrun(
             args.out)
+        doc["observability"]["step_profile"] = step_profile_dryrun(args.out)
         print(json.dumps(doc))
         return
 

@@ -33,10 +33,22 @@ from .metrics import (
     percentile,
 )
 from .plan_health import PlanHealthConfig, PlanHealthMonitor
+from .profiler import (
+    COMPONENTS,
+    NULL_PROFILER,
+    TIME_COMPONENT_FIELDS,
+    WORK_COUNTERS,
+    NullStepProfiler,
+    PlanCostCard,
+    StepProfiler,
+    plan_cost_card,
+    profiler_or_null,
+)
 from .report import (
     memory_section,
     summarize_events,
     summarize_jsonl,
+    time_budget_section,
     under_load_summary,
     validate_jsonl,
 )
@@ -75,6 +87,16 @@ __all__ = [
     "memory_section",
     "summarize_events",
     "summarize_jsonl",
+    "time_budget_section",
     "under_load_summary",
     "validate_jsonl",
+    "StepProfiler",
+    "NullStepProfiler",
+    "NULL_PROFILER",
+    "profiler_or_null",
+    "PlanCostCard",
+    "plan_cost_card",
+    "COMPONENTS",
+    "TIME_COMPONENT_FIELDS",
+    "WORK_COUNTERS",
 ]

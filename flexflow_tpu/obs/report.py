@@ -200,6 +200,7 @@ def summarize_jsonl(path: str) -> Dict:
     memory: Dict = {}
     workload: Dict = {}
     store: Dict = {}
+    profile: Dict = {}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -221,6 +222,8 @@ def summarize_jsonl(path: str) -> Dict:
                 workload = doc.get("snapshot", {})
             elif kind == "calibration_store":
                 store = doc
+            elif kind == "profile":
+                profile = doc.get("report", {})
 
     summary = summarize_events(events)
     summary["events"] = meta.get("events", len(events))
@@ -261,7 +264,43 @@ def summarize_jsonl(path: str) -> Dict:
     summary["calibration_components"] = calibration.get("components", {})
     summary["memory"] = memory_section(memory, metrics)
     summary["memory"]["pressure_events"] = summary.pop("memory_pressure")
+    # step-level cost attribution (obs/profiler.py): the phase time
+    # budget + deterministic work counters + the per-component
+    # predicted-vs-executed decomposition — None when no profiler was
+    # bound to the exporting handle
+    summary["time_budget"] = (time_budget_section(profile, calibration)
+                              if profile else None)
     return summary
+
+
+def time_budget_section(profile: Dict, calibration: Dict) -> Dict:
+    """The time-budget view: a StepProfiler report (phases + work
+    counters) joined with the calibration ledger's per-component
+    ``*_ms`` decomposition (attention / mlp / lm_head / kv_stream /
+    comms / hop / host_overhead — the vocabulary
+    ``obs.profiler.TIME_COMPONENT_FIELDS`` and
+    ``search.serve_search.pp_serve_cost`` share), so the report shows
+    WHICH component a whole-plan prediction error lives in."""
+    from .profiler import TIME_COMPONENT_FIELDS
+
+    comp_fields = set(TIME_COMPONENT_FIELDS)
+    per_plan: Dict[str, Dict] = {}
+    for plan, fields in calibration.get("plans", {}).items():
+        rows = {f: {"predicted": e.get("predicted"),
+                    "measured": e.get("measured"),
+                    "error_frac": e.get("error_frac")}
+                for f, e in fields.items() if f in comp_fields}
+        if rows:
+            per_plan[plan] = rows
+    scales = {f: c for f, c in calibration.get("components", {}).items()
+              if f in comp_fields}
+    return {
+        "ticks": profile.get("ticks"),
+        "phases": profile.get("phases", {}),
+        "work": profile.get("work", {}),
+        "components": per_plan,
+        "component_scales": scales,
+    }
 
 
 def memory_section(memory: Dict, metrics: Dict) -> Dict:
@@ -318,6 +357,7 @@ _REQUIRED_BY_KIND = {
     "calibration": ("report",),
     "memory": ("report",),
     "workload": ("snapshot",),
+    "profile": ("report",),
     "calibration_store": ("components", "applied_scales"),
 }
 
@@ -398,7 +438,7 @@ def validate_jsonl(path: str) -> List[str]:
             err(i, "counter event missing args.value")
         # typed vocabulary: the categories the report parses semantically
         cat = doc.get("cat")
-        if ph == "i" and cat in ("request", "dispatch", "plan"):
+        if ph == "i" and cat in ("request", "dispatch", "plan", "profile"):
             name = doc["name"]
             schema = EVENT_SCHEMA.get(name)
             if schema is None:
@@ -445,6 +485,16 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None
         makespan = (max(r["finish_s"] for r in done)
                     - min(r["arrival_s"] for r in recs))
     total_tokens = sum(len(r["tokens"]) for r in done)
+    # deterministic work counters (obs/profiler.py): records carry a
+    # per-request "work" dict when a StepProfiler was attached — the
+    # totals give bench_compare device-free regression fields
+    work_recs = [r["work"] for r in recs if isinstance(r.get("work"), dict)]
+    work = None
+    if work_recs:
+        from .profiler import REQUEST_WORK_COUNTERS
+
+        work = {k: sum(w.get(k, 0) for w in work_recs)
+                for k in REQUEST_WORK_COUNTERS}
     return {
         "requests": len(recs),
         "completed": len(done),
@@ -459,4 +509,5 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None
         "goodput_tokens_per_sec": (round(total_tokens / makespan, 1)
                                    if makespan else None),
         "outcomes": outcomes,
+        **({"work": work} if work is not None else {}),
     }

@@ -93,6 +93,11 @@ EVENT_SCHEMA = {
     "migration_started": ("plan", ("incumbent", "candidate")),
     "migration_completed": ("plan", ("incumbent", "candidate")),
     "migration_rolled_back": ("plan", ("incumbent", "candidate")),
+    # step-level cost attribution (obs/profiler.py): one per serve tick,
+    # emitted by StepProfiler.tick_end when a Telemetry handle is bound —
+    # args carry the tick index plus the tick's deterministic work-counter
+    # deltas (flops, kv_bytes_touched, dispatches, ...)
+    "step_profile": ("profile", ("tick",)),
 }
 
 # migration counter/gauge vocabulary (report.py folds these into the
@@ -130,6 +135,10 @@ class Telemetry:
         # optional persisted CalibrationStore: attach one to have export()
         # write its applied scales alongside the ledger report
         self.store = None
+        # optional StepProfiler (obs/profiler.py), bound via
+        # StepProfiler.bind(telemetry): export() then writes the phase
+        # time budget + deterministic work counters as a "profile" line
+        self.profiler = None
 
     # ---- primitive delegation -----------------------------------------
     def now(self) -> float:
@@ -389,7 +398,7 @@ class Telemetry:
     # ---- snapshot / export --------------------------------------------
     def snapshot(self) -> Dict:
         """One JSON-ready dict of everything the handle accumulated."""
-        return {
+        snap = {
             "metrics": self.metrics.snapshot(),
             "calibration": self.calibration.report(),
             "memory": self.memory.report(),
@@ -397,6 +406,9 @@ class Telemetry:
             "trace": {"events": self.trace.emitted,
                       "dropped": self.trace.dropped},
         }
+        if self.profiler is not None:
+            snap["profile"] = self.profiler.report()
+        return snap
 
     def export(self, out_dir: str, prefix: str = "telemetry") -> Dict[str, str]:
         """Write ``<prefix>.trace.json`` (Chrome/Perfetto) and
@@ -426,6 +438,10 @@ class Telemetry:
                                 "report": self.memory.report()}) + "\n")
             f.write(json.dumps({"kind": "workload",
                                 "snapshot": self.workload.snapshot()}) + "\n")
+            if self.profiler is not None:
+                f.write(json.dumps({"kind": "profile",
+                                    "report": self.profiler.report()})
+                        + "\n")
             if self.store is not None:
                 f.write(json.dumps({"kind": "calibration_store",
                                     "path": self.store.path,

@@ -37,6 +37,7 @@ from .simulator import (
     _step_param_bytes,
     compose_stage_parts,
     plan_memory_parts,
+    serve_component_of,
     step_state_bytes,
 )
 
@@ -63,7 +64,9 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
                   kv_fill_frac: float = 1.0,
                   prefill_tok_per_s: float = 0.0,
                   prompt_len: float = 0.0,
-                  batch_rows: int = 0) -> Dict:
+                  batch_rows: int = 0,
+                  component_scales: Optional[Dict[str, float]] = None
+                  ) -> Dict:
     """Simulated STEADY-STATE decode cost for a stage-split serve plan.
 
     The graph's flat batch (``R_tot`` concurrent decode slots) splits into
@@ -106,17 +109,43 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
       while tp divides the prefill compute per chip.  The classic
       TTFT-vs-TPOT asymmetry that makes the best plan workload-dependent.
 
+    ``component_scales`` (step-level cost attribution, obs/profiler.py):
+    per-component multiplicative corrections keyed by the shared
+    ``*_ms`` field names (``attention_ms`` / ``mlp_ms`` / ``lm_head_ms``
+    / ``kv_stream_ms`` / ``comms_ms`` / ``hop_ms`` /
+    ``host_overhead_ms``) — the CalibrationStore's component-level
+    ``suggested_scale`` entries, applied to each stage's term BEFORE the
+    bottleneck max, so a mispriced hop corrects only the hop.  The tick
+    is decomposed exactly: per stage, each op family contributes its own
+    weight stream + compute share (attention ops / the LM-head-marked
+    Linear / everything else as "mlp"), plus the 1/m-amortized KV stream
+    and TP collectives, the per-tick dispatch overhead, and the
+    inter-stage hop — the terms SUM to the tick, so the returned
+    ``components`` (ms, TPOT basis) sum to ``tpot_s``.
+
     Returns ``{tpot_s, tick_s, bubble_frac, transfer_s, stage_ticks,
-    prefill_util, ttft_s}`` (``ttft_s`` None unless ``prompt_len`` given).
+    prefill_util, ttft_s, components}`` (``ttft_s`` None unless
+    ``prompt_len`` given).
     """
     spec = machine.spec
     peak = spec.peak_flops_bf16 * spec.mxu_efficiency
+    cs = component_scales or {}
+
+    def _sc(name: str) -> float:
+        return float(cs.get(f"{name}_ms", 1.0))
+
     ticks: List[float] = []
+    stage_comps: List[Dict[str, float]] = []
     stage_fl: List[float] = []
     stage_w: List[float] = []
     for plan in stage_plans:
         mesh = plan.mesh
-        w = fl = comm = 0.0
+        # per-op-family weight bytes + flops: the component decomposition
+        # the calibration ledger reconciles (attention / mlp / lm_head),
+        # same _step_flops/_step_param_bytes arithmetic as before
+        fam_w = {"attention": 0.0, "mlp": 0.0, "lm_head": 0.0}
+        fam_fl = {"attention": 0.0, "mlp": 0.0, "lm_head": 0.0}
+        comm = 0.0
         for step in plan.steps:
             if step.is_parallel:
                 op = step.node.op
@@ -125,23 +154,36 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
                 comm += machine.collective_time(
                     b, getattr(op, "axes", ()), mesh)
                 continue
-            w += _step_param_bytes(step, plan, mesh)
-            if step.node.op.type_name in HEAVY_OPS:
-                fl += _step_flops(step, mesh)
+            op = step.node.op
+            fam = serve_component_of(op)
+            fam_w[fam] += _step_param_bytes(step, plan, mesh)
+            if op.type_name in HEAVY_OPS:
+                fam_fl[fam] += _step_flops(step, mesh)
         kv = _stage_kv_bytes(plan) * kv_fill_frac
-        tick = (
-            w / spec.hbm_bandwidth
-            + (fl / peak + kv / spec.hbm_bandwidth + comm) / n_micro
-            + spec.step_overhead
-        )
-        ticks.append(tick)
-        stage_fl.append(fl)
-        stage_w.append(w)
+        raw = {
+            fam: (fam_w[fam] / spec.hbm_bandwidth
+                  + fam_fl[fam] / peak / n_micro)
+            for fam in ("attention", "mlp", "lm_head")
+        }
+        raw["kv_stream"] = kv / spec.hbm_bandwidth / n_micro
+        raw["comms"] = comm / n_micro
+        raw["host_overhead"] = spec.step_overhead
+        comps = {name: v * _sc(name) for name, v in raw.items()}
+        ticks.append(sum(comps.values()))
+        stage_comps.append((comps, raw))
+        stage_fl.append(sum(fam_fl.values()))
+        stage_w.append(sum(fam_w.values()))
     s = len(stage_plans)
-    hop = machine.transfer_time(boundary_bytes / max(n_micro, 1), pp_axes) \
-        if s > 1 else 0.0
-    tick = max(ticks) + hop
+    hop_raw = machine.transfer_time(boundary_bytes / max(n_micro, 1),
+                                    pp_axes) if s > 1 else 0.0
+    hop = hop_raw * _sc("hop")
+    bottleneck = max(range(s), key=lambda i: ticks[i])
+    tick = ticks[bottleneck] + hop
     tpot = max(n_micro, s) * tick
+    comps, comps_raw = (dict(stage_comps[bottleneck][0]),
+                        dict(stage_comps[bottleneck][1]))
+    comps["hop"] = hop
+    comps_raw["hop"] = hop_raw
 
     rho = 0.0
     if prefill_tok_per_s > 0 and batch_rows > 0:
@@ -162,6 +204,21 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
             for fl_i, w_i in zip(stage_fl, stage_w)
         ) + (s - 1) * hop + s * spec.step_overhead
 
+    # per-component times on the TPOT basis (x max(m,S), x the same
+    # 1/(1-rho) inflation), so components sum to tpot_s — the predicted
+    # side of the component-level calibration pairs (the `*_ms` ledger
+    # fields shared with obs/profiler.TIME_COMPONENT_FIELDS).
+    # ``components_raw`` is the UNSCALED decomposition: the calibration
+    # ledger must record the raw model (pre-correcting what the loop is
+    # trying to estimate would make the stored scale converge to
+    # sqrt(truth) instead of truth — the same principle the memory
+    # ledger documents); the scaled ``components`` are what the ranking
+    # actually used.
+    basis = max(n_micro, s) / (1.0 - rho)
+    components = {f"{name}_ms": round(v * basis * 1e3, 6)
+                  for name, v in comps.items()}
+    components_raw = {f"{name}_ms": round(v * basis * 1e3, 6)
+                      for name, v in comps_raw.items()}
     return {
         "tpot_s": tpot,
         "tick_s": tick,
@@ -170,6 +227,8 @@ def pp_serve_cost(stage_plans, machine: MachineModel, n_micro: int = 1,
         "stage_ticks": ticks,
         "prefill_util": round(rho, 4),
         "ttft_s": ttft,
+        "components": components,
+        "components_raw": components_raw,
     }
 
 
@@ -256,6 +315,26 @@ def _workload_features(workload) -> Optional[Dict[str, float]]:
         return dict(workload)
     raise TypeError(f"workload must be a WorkloadProfile or features dict, "
                     f"got {type(workload).__name__}")
+
+
+def store_component_scales(store) -> Optional[Dict[str, float]]:
+    """The CalibrationStore's component-level time scales (step-level
+    cost attribution, obs/profiler.py): entries named after the shared
+    ``*_ms`` component fields (``attention_ms`` ... ``host_overhead_ms``)
+    that clear the store's min-sample gate.  Returns None when the store
+    is absent or no component entry applies — the pricing then runs
+    exactly as before.  Consulted by :func:`search_serve_plan` (and
+    available to :func:`price_plan` callers) at the component-pricing
+    layer; constant-level entries (``step_overhead``, ``hbm_bandwidth``,
+    ...) keep going through ``MachineModel.with_store`` — the two
+    vocabularies are disjoint, so a correction is never applied twice."""
+    if store is None:
+        return None
+    from ..obs.profiler import TIME_COMPONENT_FIELDS
+
+    scales = {f: store.scale_for(f) for f in TIME_COMPONENT_FIELDS}
+    scales = {f: s for f, s in scales.items() if s != 1.0}
+    return scales or None
 
 
 def _resolve_store(calibration):
@@ -466,6 +545,24 @@ def search_serve_plan(
     s_ttft = store.scale_for("ttft_ms") if store else 1.0
     s_xfer = store.scale_for("transfer_ms") if store else 1.0
     s_mem = store.scale_for("memory_gb") if store else 1.0
+    # component-level scales (attention_ms ... host_overhead_ms): applied
+    # inside pp_serve_cost's decomposition, so a store entry learned from
+    # per-component reconciliation corrects ONLY that component's term.
+    # When they apply, the whole-plan tpot_ms scale is SUPERSEDED — the
+    # component layer already corrects the tick it is composed of, and
+    # stacking the coarse scale on top would double-correct (the
+    # component pairs and the tpot pair were measured on the same runs)
+    comp_scales = store_component_scales(store)
+    if comp_scales:
+        # the coarse whole-field time scales are SUPERSEDED: the
+        # component layer already corrects the tick (tpot) and the hop
+        # (transfer) it is composed of — stacking them would
+        # double-correct, since the component and field pairs were
+        # measured on the same runs.  (ttft keeps its field scale: its
+        # compute share is not component-corrected; the hop share's
+        # residual overlap is second-order.)
+        s_tpot = 1.0
+        s_xfer = 1.0
 
     candidates: Dict[str, Dict] = {}
     raw_parts_by_plan: Dict[str, Dict] = {}
@@ -517,7 +614,8 @@ def search_serve_plan(
                                  kv_fill_frac=kv_fill,
                                  prefill_tok_per_s=prefill_rate,
                                  prompt_len=prompt_len,
-                                 batch_rows=rows)
+                                 batch_rows=rows,
+                                 component_scales=comp_scales)
             tpot_s = cost["tpot_s"] * s_tpot
             ttft_s = (cost["ttft_s"] * s_ttft
                       if cost["ttft_s"] is not None else None)
@@ -577,6 +675,13 @@ def search_serve_plan(
                         "prefill_util": cost["prefill_util"],
                         "per_stage_gb": entry["per_stage_gb"],
                         "spec": sinfo,
+                        # the winning plan's per-component decomposition
+                        # (the incremental tick's, spec-factor excluded —
+                        # the same basis price_plan replays, so component
+                        # pairs compare like against like); _raw is the
+                        # uncorrected model the ledger records
+                        "components_ms": dict(cost["components"]),
+                        "components_raw_ms": dict(cost["components_raw"]),
                     }
                     if ttft_s is not None:
                         best["ttft_ms"] = round(ttft_s * 1e3, 4)
@@ -615,6 +720,15 @@ def search_serve_plan(
             transfer_ms=best["transfer_ms"],
             memory_gb=round(max(best["per_stage_gb"]) * s_mem, 4),
             ttft_ms=best.get("ttft_ms"),
+            # per-component predictions (attention_ms ... hop_ms ...):
+            # the decomposed side the StepProfiler/price_plan "executed"
+            # components reconcile against, so a prediction error is
+            # attributable to ONE mispriced component.  RAW (un-scaled)
+            # values — the ledger estimates model-vs-reality, so the
+            # store's own corrections must not pre-correct the record
+            # (a corrected prediction would EWMA the stored scale toward
+            # sqrt(truth) instead of truth)
+            **best["components_raw_ms"],
         )
         # byte-side ledger: RAW per-component parts, unscaled AND
         # unrounded (the memory ledger measures model-vs-reality, so
@@ -642,9 +756,17 @@ def price_plan(
     workload=None,
     kv_page_size=None,
     spec=None,
+    component_scales: Optional[Dict[str, float]] = None,
 ) -> Dict:
     """Price ONE tp x pp x m factorization with the same stage-split and
     cost machinery :func:`search_serve_plan` ranks with.
+
+    The result carries the per-component ``components`` decomposition
+    (``attention_ms`` ... ``host_overhead_ms`` — obs/profiler.py's
+    shared vocabulary), so pricing the executing plan on the TRUE
+    machine constants yields the "executed" side of a component-level
+    calibration pair.  ``component_scales`` replays a store's component
+    corrections (see :func:`store_component_scales`).
 
     The replay/ground-truth half of the calibration loop: given the
     executing plan's coordinates and a DIFFERENT machine model (e.g. the
@@ -684,6 +806,7 @@ def price_plan(
         plans, mm, n_micro=n_micro,
         boundary_bytes=_boundary_bytes(graph, split),
         batch_rows=_graph_rows(graph, attn0),
+        component_scales=component_scales,
         **knobs,
     )
     cost["plan_key"] = f"tp{tp}_pp{pp}_m{n_micro}"

@@ -73,6 +73,24 @@ HEAVY_OPS = frozenset({
 })
 
 
+def serve_component_of(op) -> str:
+    """Serve-graph cost-component family of one op: ``attention`` /
+    ``lm_head`` / ``mlp`` — THE one classifier both sides of the
+    step-level cost attribution share (``serve_search.pp_serve_cost``'s
+    decomposed pricing and ``obs.profiler.plan_cost_card``'s
+    deterministic counters), so a new op type cannot be priced as one
+    family and counted as another.  Attention = any serve attention
+    variant (type name ends in ``multihead_self_attention``); lm_head =
+    the Linear the InferenceManager marked for LM-head gating
+    (``cost_logit_rows``); everything else (MLP linears, embedding,
+    norms' weights) folds into ``mlp``."""
+    if op.type_name.endswith("multihead_self_attention"):
+        return "attention"
+    if getattr(op, "cost_logit_rows", None) is not None:
+        return "lm_head"
+    return "mlp"
+
+
 def _step_flops(step: Step, mesh) -> float:
     """Local (per-device) flops: global scaled by the output shard fraction
     (+ contracted-dim sharding for partial outputs)."""

@@ -71,6 +71,7 @@ class LLM:
         resilience=None,
         fault_injector=None,
         plan_health=None,
+        profiler=None,
     ) -> "LLM":
         """``kv_dtype="int8"`` stores the KV caches int8 with fused
         in-kernel dequant (see ``InferenceManager``) — halves decode KV
@@ -90,7 +91,10 @@ class LLM:
         (SLO / prediction-error / workload-drift checks emitting
         ``replan_recommended``; pair it with :meth:`attach_migration` to
         ACT on the recommendation via a live plan switch — see
-        :meth:`health`)."""
+        :meth:`health`).  ``profiler`` attaches a
+        :class:`~flexflow_tpu.obs.StepProfiler` (step-level cost
+        attribution: per-phase time budgets + deterministic work
+        counters; bit-identical outputs with it on or off)."""
         devices = devices if devices is not None else jax.devices()[:tp]
         mesh = make_mesh({"tp": tp}, devices)
         ff = FFModel(FFConfig(), mesh=mesh)
@@ -136,12 +140,14 @@ class LLM:
                 self.im, ssm.im, gen, width=spec_width, depth=spec_depth,
                 telemetry=telemetry, resilience=resilience,
                 fault_injector=fault_injector, plan_health=plan_health,
+                profiler=profiler,
             )
         else:
             self.rm = RequestManager(self.im, gen, telemetry=telemetry,
                                      resilience=resilience,
                                      fault_injector=fault_injector,
-                                     plan_health=plan_health)
+                                     plan_health=plan_health,
+                                     profiler=profiler)
         return self
 
     def health(self):

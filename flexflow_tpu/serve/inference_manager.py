@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..core.interpreter import build_forward
 from ..core.pcg import PCG
+from ..obs.profiler import NULL_PROFILER
 from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
 from .kv_allocator import (  # noqa: F401 — re-exported for compat
@@ -232,6 +233,12 @@ class InferenceManager:
     # any work reaches the device, so an injected fault leaves no partial
     # device state and a retried dispatch replays identical compute.
     fault_injector = None
+    # step-level cost attribution (obs/profiler.py), synced by the
+    # RequestManager like the telemetry handle: dispatch-phase timing +
+    # the dispatch counter live HERE (the program-launch sites); the
+    # deterministic flops/byte accounting lives in the RequestManager
+    # (host bookkeeping).  Host-side only — never traced into a program.
+    profiler = NULL_PROFILER
 
     def __init__(
         self,
@@ -601,8 +608,11 @@ class InferenceManager:
         # device time shows up at the result readback, not here.  Dispatch
         # spans live on their own track: they nest inside the serve loop's
         # spans, and per-track totals assume non-overlapping spans per track
+        prof = self.profiler
+        if prof.enabled:
+            prof.count("dispatches")
         with self.telemetry.span("step_dispatch", cat="dispatch",
-                                 track="dispatch"):
+                                 track="dispatch"), prof.phase("dispatch"):
             result, self.state = self._step(self.params, self.state, bc,
                                             sample, None, None,
                                             self._page_view())
@@ -697,8 +707,12 @@ class InferenceManager:
             )
         if self.fault_injector is not None:
             self.fault_injector.maybe_fail("decode_scan")
+        prof = self.profiler
+        if prof.enabled:
+            prof.count("dispatches")
         with self.telemetry.span("decode_scan_dispatch", cat="dispatch",
-                                 track="dispatch", n_steps=n_steps):
+                                 track="dispatch",
+                                 n_steps=n_steps), prof.phase("dispatch"):
             tokens, live, self.state, bc = self._scan(
                 self.params, self.state, bc, sample, self._page_view(),
                 n_steps=n_steps, eos=eos
@@ -834,9 +848,13 @@ class InferenceManager:
         assert self.params is not None, "call init_operators_inference() first"
         if self.fault_injector is not None:
             self.fault_injector.maybe_fail("prefill_scan")
+        prof = self.profiler
+        if prof.enabled:
+            prof.count("dispatches")
         with self.telemetry.span("prefill_scan_dispatch", cat="dispatch",
                                  track="dispatch",
-                                 n_chunks=int(bcs.base.tokens.shape[0])):
+                                 n_chunks=int(bcs.base.tokens.shape[0])), \
+                prof.phase("dispatch"):
             tokens, self.state = self._pscan(
                 self.params, self.state, bcs, sample, self._page_view(),
                 overlap=bool(self.prefill_overlap

@@ -186,6 +186,11 @@ class PagedKVAllocator(KVAllocator):
         self.prefix_tokens_reused = 0
         self.cow_copies = 0
         self.pages_evicted = 0
+        # cumulative table-mapping count (every real page mapped into a
+        # request's row, incl. COW destinations and prefix-reuse binds) —
+        # the StepProfiler polls this into its deterministic
+        # ``pages_mapped`` work counter (obs/profiler.py)
+        self.pages_mapped = 0
         self._init_pool()
 
     # ------------------------------------------------------------------
@@ -288,6 +293,7 @@ class PagedKVAllocator(KVAllocator):
     def _map(self, slot: int, k: int, pid: int) -> None:
         self._table[slot, k] = pid
         self._req_refs[pid] += 1
+        self.pages_mapped += 1
         self._invalidate_device()
 
     def _unmap(self, slot: int, k: int) -> None:
@@ -587,6 +593,7 @@ class PagedKVAllocator(KVAllocator):
             "prefix_tokens_reused": self.prefix_tokens_reused,
             "cow_copies": self.cow_copies,
             "pages_evicted": self.pages_evicted,
+            "pages_mapped_total": self.pages_mapped,
         })
         return snap
 

@@ -430,6 +430,12 @@ class MigrationController:
         if isinstance(built, RequestManager):
             return built
         tel = rm.telemetry if rm.telemetry.enabled else None
+        # the StepProfiler handle crosses the switch like telemetry: rids
+        # are preserved, so the per-request work attribution keeps
+        # accumulating in ONE table across managers (and the successor's
+        # jitted programs join the recompile poll via install())
+        prof = rm.profiler if getattr(rm, "profiler", None) is not None \
+            and rm.profiler.enabled else None
         if isinstance(built, (tuple, list)):
             from .spec_infer import SpecInferManager
 
@@ -446,10 +452,10 @@ class MigrationController:
             return SpecInferManager(
                 llm_im, ssm_im, rm.gen, width=width, depth=depth,
                 telemetry=tel, resilience=rm.res,
-                fault_injector=rm.injector, clock=rm.clock)
+                fault_injector=rm.injector, clock=rm.clock, profiler=prof)
         return RequestManager(built, rm.gen, telemetry=tel,
                               resilience=rm.res, fault_injector=rm.injector,
-                              clock=rm.clock)
+                              clock=rm.clock, profiler=prof)
 
     def _readmit(self, rm: RequestManager, new_rm: RequestManager,
                  candidate: Dict) -> int:
@@ -563,6 +569,16 @@ class MigrationController:
             rm.admission_closed = True
             rm.migration = None
             leaked = self._teardown(rm)
+            # release the retired deployment from the profiler's
+            # recompile/page polls (compiles-so-far fold into the
+            # counter) — without this, every migration would pin the
+            # incumbent's jitted programs alive through the poll list
+            prof = getattr(rm, "profiler", None)
+            if prof is not None and prof.enabled:
+                prof.uninstall(rm.im)
+                ssm = getattr(rm, "ssm", None)
+                if ssm is not None:
+                    prof.uninstall(ssm)
             new_rm.migration = self
             self.rm = new_rm
         new_rm.admission_closed = False

@@ -46,6 +46,7 @@ import numpy as np
 from ..core.graph import live_cuts
 from ..core.interpreter import build_forward, init_params
 from ..core.pcg import PCG
+from ..obs.profiler import NULL_PROFILER
 from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
 from .inference_manager import (
@@ -233,6 +234,12 @@ class PipelinedInferenceManager:
     # safe because stage KV writes are positional and value-deterministic
     # (a replayed micro-batch rewrites identical values; see _dispatch).
     fault_injector = None
+    # step-level cost attribution (obs/profiler.py), synced by the
+    # RequestManager: per-stage dispatch phases (``stage{i}``) time the
+    # host-interleaved stage compute, ``hop`` times the inter-stage
+    # activation transfer, and every stage program launch counts into the
+    # deterministic ``dispatches`` counter.  Host-side only.
+    profiler = NULL_PROFILER
 
     def __init__(
         self,
@@ -554,13 +561,14 @@ class PipelinedInferenceManager:
         on the receiving stage's track.
         """
         tel = self.telemetry
+        prof = self.profiler
         fi = self.fault_injector
         xs: Tuple = ()
         res = None
         n = len(self.stages)
         for s, stage in enumerate(self.stages):
             with tel.span("stage_dispatch", cat="pp", track=f"stage{s}",
-                          stage=s, mb=mb):
+                          stage=s, mb=mb), prof.phase(f"stage{s}"):
                 if fi is not None:
                     fi.maybe_fail(f"stage{s}_dispatch")
                 bc_s = jax.device_put(bc, stage.replicated)
@@ -573,8 +581,11 @@ class PipelinedInferenceManager:
                                 stage=s, mb=mb)
                     if tel.enabled:
                         tel.metrics.counter("pp_hops").inc()
-                    xs = tuple(jax.device_put(x, stage.replicated)
-                               for x in xs)
+                    with prof.phase("hop"):
+                        xs = tuple(jax.device_put(x, stage.replicated)
+                                   for x in xs)
+                if prof.enabled:
+                    prof.count("dispatches")
                 if s < n - 1:
                     xs, stage.state = stage.step(stage.params, stage.state,
                                                  bc_s, xs, None, pg_s)

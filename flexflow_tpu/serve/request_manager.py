@@ -136,7 +136,8 @@ class RequestManager:
 
     def __init__(self, im, gen_config: Optional[GenerationConfig] = None,
                  telemetry=None, resilience: Optional[ResilienceConfig] = None,
-                 fault_injector=None, clock=None, plan_health=None):
+                 fault_injector=None, clock=None, plan_health=None,
+                 profiler=None):
         import time as _time
 
         self.im = im
@@ -158,6 +159,21 @@ class RequestManager:
         self.telemetry = telemetry_or_null(telemetry)
         im.telemetry = self.telemetry
         self._tstamps: Dict[int, Dict[str, float]] = {}  # rid -> stamps
+        # step-level cost attribution (obs/profiler.py): ONE StepProfiler
+        # handle shared with the InferenceManager (and every pp stage /
+        # the spec draft model) exactly like the telemetry handle — and,
+        # like it, ALWAYS synced so a shared/cached im can never leak a
+        # previous run's live profiler.  Host-side only: phase timing +
+        # deterministic counters computed from host bookkeeping, never a
+        # device read — serve outputs are bit-identical with the profiler
+        # on or off (tests/test_profiler.py).
+        from ..obs.profiler import profiler_or_null
+
+        self.profiler = profiler_or_null(profiler)
+        im.profiler = self.profiler
+        if self.profiler.enabled:
+            self.profiler.install(im)
+            self.profiler.bind(self.telemetry)
         # KV ownership (serve/kv_allocator.py): a fresh manager restarts
         # rids from 0, so any attribution a previous manager left on a
         # shared/cached im must not alias the new rid space; and the
@@ -656,6 +672,20 @@ class RequestManager:
                     self._sleep(delay)
 
     # ------------------------------------------------------------------
+    def _prof_account(self, spans, passes: int = 1, logit_rows=None,
+                      im=None) -> None:
+        """Deterministic work accounting for one dispatch group
+        (obs/profiler.py): ``spans`` are the same ``(rid, lo, hi)``
+        cache-write spans ``_kv_prepare`` consumes — ``hi - lo`` tokens
+        fed, reading the ``hi``-deep causally-live prefix.  Host
+        arithmetic only; no-op for the null profiler."""
+        prof = self.profiler
+        if not prof.enabled or not spans:
+            return
+        prof.account(prof.card_for(im or self.im),
+                     [(rid, hi - lo, hi) for rid, lo, hi in spans],
+                     passes=passes, logit_rows=logit_rows)
+
     def _pop_pending(self) -> int:
         """Highest-priority pending rid, FIFO within a priority class."""
         best = max(range(len(self.pending)),
@@ -809,6 +839,8 @@ class RequestManager:
                 for slot, rid in sample_points
             ]
             self._kv_prepare(spans)
+            self._prof_account(
+                spans, logit_rows=len(sample_points) if gate else None)
             self._note_batch(0, sum(len(s[1]) for s in segments), seq_lens)
             return pbc, sample_points
 
@@ -885,6 +917,7 @@ class RequestManager:
             max_requests=self.im.max_requests,
         )
         self._kv_prepare(spans)
+        self._prof_account(spans)
         self._note_batch(n_decode, len(tokens) - n_decode, seq_lens)
         return bc, sample_points
 
@@ -922,7 +955,10 @@ class RequestManager:
             # mid-prefill step: nothing to read back — leave the result on
             # device so chunked prefill dispatches stay fully async
             return
-        token_ids = np.asarray(result.token_ids)
+        prof = self.profiler
+        with prof.phase("readback"):
+            token_ids = np.asarray(result.token_ids)
+        prof.host_sync()
         for flat_idx, rid in sample_points:
             req = self.requests[rid]
             if req.status not in (RequestStatus.PREFILLING,
@@ -1076,6 +1112,12 @@ class RequestManager:
                 ridx = req.slot if gate else last_flat[req.slot]
                 if done:
                     points.append((len(chunks), ridx, req.rid))
+                # deterministic accounting: one model pass per chunk;
+                # gated chunks materialize logits only at the (single)
+                # completing request's slot
+                self._prof_account(
+                    [(req.rid, start, start + take)],
+                    logit_rows=(1 if done else 0) if gate else None)
                 if sampling:
                     fc = np.zeros((n_rows, 2), np.int32)
                     if done:
@@ -1121,7 +1163,9 @@ class RequestManager:
                 return
             outs.append((at, res))
             at += seg
-        toks = {start: np.asarray(t) for start, t in outs}  # one sync
+        with self.profiler.phase("readback"):
+            toks = {start: np.asarray(t) for start, t in outs}  # one sync
+        self.profiler.host_sync(len(outs))
         starts = sorted(toks)
         for chunk_idx, flat_idx, rid in points:
             start = max(s for s in starts if s <= chunk_idx)
@@ -1146,20 +1190,31 @@ class RequestManager:
                   if r.status is RequestStatus.DECODING]
         if not active:
             return
-        tokens, reqi, pos = [], [], []
-        points = []
-        for req in active:
-            tokens.append(req.generated[-1])
-            reqi.append(req.slot)
-            pos.append(req.seq_len - 1)
-            points.append(req.rid)
-        seq_lens = np.zeros(self.im.max_requests, np.int32)
-        for req in active:
-            seq_lens[req.slot] = req.seq_len
-        bc = BatchConfig.build(
-            tokens, reqi, pos, seq_lens,
-            max_tokens=self.im.max_tokens, max_requests=self.im.max_requests,
-        )
+        prof = self.profiler
+        with prof.phase("host_prepare"):
+            tokens, reqi, pos = [], [], []
+            points = []
+            for req in active:
+                tokens.append(req.generated[-1])
+                reqi.append(req.slot)
+                pos.append(req.seq_len - 1)
+                points.append(req.rid)
+            seq_lens = np.zeros(self.im.max_requests, np.int32)
+            for req in active:
+                seq_lens[req.slot] = req.seq_len
+            bc = BatchConfig.build(
+                tokens, reqi, pos, seq_lens,
+                max_tokens=self.im.max_tokens,
+                max_requests=self.im.max_requests,
+            )
+        if prof.enabled:
+            # n decode steps: each streams the weights and reads the
+            # growing causally-live prefix (seq, seq+1, ... seq+n-1)
+            prof.account(
+                prof.card_for(self.im),
+                [(r.rid, n, n * r.seq_len + n * (n - 1) // 2)
+                 for r in active],
+                passes=n)
         eos = self.gen.eos_token_id if self.gen.stop_on_eos else None
         # per-request sample keys: row i starts at (rid_i, len(generated_i))
         # and the scan advances the token index per step on device
@@ -1171,8 +1226,10 @@ class RequestManager:
             self.scan_runs += 1
             return
         toks, live, _ = out
-        toks = np.asarray(toks)
-        live = np.asarray(live)
+        with prof.phase("readback"):
+            toks = np.asarray(toks)
+            live = np.asarray(live)
+        prof.host_sync()
         for s in range(n):
             for flat, rid in enumerate(points):
                 req = self.requests[rid]
@@ -1200,7 +1257,8 @@ class RequestManager:
                 self._decode_stretch(n)
             return
         with tel.span("serve_step", cat="serve"):
-            bc, sample_points = self.prepare_next_batch()
+            with self.profiler.phase("host_prepare"):
+                bc, sample_points = self.prepare_next_batch()
             base = bc if isinstance(bc, BatchConfig) else bc.base
             if int(np.asarray(base.num_tokens)) == 0:
                 # nothing slotted fed a token (admission closed during a
@@ -1503,7 +1561,9 @@ class RequestManager:
                     continue
                 self.scan_chunk = quantum if pending else saved_chunk
                 starters = prefill_starters()
+                self.profiler.tick_begin()
                 self._tick()
+                self.profiler.tick_end()
                 self._sync_kv()
                 self._maybe_check_health()
                 for rid in starters:
@@ -1528,6 +1588,11 @@ class RequestManager:
             # byte-side attribution: peak committed-KV this request held
             # (0.0 for rejected/never-slotted requests)
             rec["kv_bytes"] = req.kv_bytes
+            # deterministic per-request work counters (obs/profiler.py):
+            # flops / kv_bytes_touched / dispatches — device-free fields
+            # the under-load summary totals and bench_compare guards
+            if self.profiler.enabled:
+                rec["work"] = self.profiler.request_work(rid)
             # ALWAYS emit the TTFT decomposition: queue wait runs from
             # arrival to prefill start (falling back to registration, then
             # arrival, when prefill never began); prefill runs from there
@@ -1561,7 +1626,9 @@ class RequestManager:
                 if new_rm is not None:
                     return new_rm.serve_incr_decoding()
                 break
+            self.profiler.tick_begin()
             self._tick()
+            self.profiler.tick_end()
             self._sync_kv()
             self._maybe_check_health()
             new_rm = self._maybe_migrate()

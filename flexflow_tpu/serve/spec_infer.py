@@ -127,11 +127,12 @@ class SpecInferManager(RequestManager):
         fault_injector=None,
         clock=None,
         plan_health=None,
+        profiler=None,
     ):
         super().__init__(llm, gen_config, telemetry=telemetry,
                          resilience=resilience,
                          fault_injector=fault_injector, clock=clock,
-                         plan_health=plan_health)
+                         plan_health=plan_health, profiler=profiler)
         self.llm = llm
         self.ssm = ssm
         self.width = width
@@ -166,6 +167,12 @@ class SpecInferManager(RequestManager):
         # and its predicted-vs-allocated record lands in the memory
         # ledger under its own "_draft" plan key — same tp/pp shape as
         # the target must not collide with the target's record
+        # the draft model shares the ONE profiler handle (like telemetry):
+        # its dispatches/jit caches join the dispatch + recompile
+        # accounting, and its work is priced with its OWN cost card
+        ssm.profiler = self.profiler
+        if self.profiler.enabled:
+            self.profiler.install(ssm)
         kv_s = getattr(ssm, "kv", None)
         if kv_s is not None:
             kv_s.reset_attribution()
@@ -335,6 +342,7 @@ class SpecInferManager(RequestManager):
             if not toks:
                 break
             self._kv_prepare(spans)
+            self._prof_account(spans)
             bc = self._plain_bc(self.llm, toks, reqi, pos)
             # per-request (rid, token_index) sample folds so the first
             # generated token (read off the last fed position's logits) is
@@ -349,7 +357,9 @@ class SpecInferManager(RequestManager):
             if result is None:
                 return
             self.llm_steps += 1
-            ids = np.asarray(result.token_ids)
+            with self.profiler.phase("readback"):
+                ids = np.asarray(result.token_ids)
+            self.profiler.host_sync()
             for flat, rid in points:
                 req = self.requests[rid]
                 if req.status is not RequestStatus.PREFILLING:
@@ -401,6 +411,7 @@ class SpecInferManager(RequestManager):
             if not toks:
                 break
             self._kv_prepare(spans, kv=getattr(self.ssm, "kv", None))
+            self._prof_account(spans, im=self.ssm)
             bc = self._plain_bc(self.ssm, toks, reqi, pos)
             if self._guarded("spec_ssm_prefill",
                              lambda b=bc: self.ssm.step(b)) is None:
@@ -465,12 +476,23 @@ class SpecInferManager(RequestManager):
                 TreeSearchBatchConfig, self.ssm, toks, reqi, pos, spec, masks,
                 committed_attr="ssm_committed",
             )
+            prof = self.profiler
+            if prof.enabled and toks:
+                per: Dict[int, int] = {}
+                for _, rid, _ni in points:
+                    per[rid] = per.get(rid, 0) + 1
+                prof.account(
+                    prof.card_for(self.ssm),
+                    [(rid, c, self.requests[rid].seq_len)
+                     for rid, c in per.items()])
             result = self._guarded("spec_draft",
                                    lambda b=bc: self.ssm.step(b))
             if result is None:
                 return []
-            topk_ids = np.asarray(result.topk_ids)
-            topk_lp = np.asarray(result.topk_logprobs)
+            with prof.phase("readback"):
+                topk_ids = np.asarray(result.topk_ids)
+                topk_lp = np.asarray(result.topk_logprobs)
+            prof.host_sync()
             # beam-select the next frontier per request
             for req in drafting:
                 cands = []
@@ -590,6 +612,13 @@ class SpecInferManager(RequestManager):
         # recompute bit-identity contracts rest on.  T<=0 keeps the
         # exact-greedy walk.
         smp = self._verify_sample(verifying, index_of)
+        prof = self.profiler
+        if prof.enabled:
+            # one verify macro-step: each row ships its whole tree (a
+            # root-only tree for plain rows) and reads its live prefix
+            prof.account(
+                prof.card_for(self.llm),
+                [(r.rid, len(r.tree), r.seq_len) for r in verifying])
         n_spec = sum(1 for r in verifying if len(r.tree) > 1)
         n_plain = len(verifying) - n_spec
         if tel.enabled:
@@ -602,7 +631,9 @@ class SpecInferManager(RequestManager):
         if result is None:
             return
         self.llm_steps += 1
-        ids = np.asarray(result.token_ids)
+        with prof.phase("readback"):
+            ids = np.asarray(result.token_ids)
+        prof.host_sync()
 
         for req in verifying:
             if req.status is not RequestStatus.DECODING:
@@ -760,6 +791,7 @@ class SpecInferManager(RequestManager):
             if not toks:
                 break
             self._kv_prepare(spans)
+            self._prof_account(spans)
             bc = self._plain_bc(self.llm, toks, reqi, pos)
             # a flush fault past the retry budget affects only the rows
             # actually IN the failed batch (a budget-limited flush may

@@ -1,0 +1,178 @@
+"""Perf-regression comparator over two bench artifacts.
+
+Usage::
+
+    python scripts/bench_compare.py OLD.json NEW.json
+    python scripts/bench_compare.py OLD.json NEW.json \
+        --default-threshold 0.1 --threshold tpot_ms=0.05
+
+Diffs two JSON bench artifacts (``bench.py`` output, a ``--dry-run``
+section, or any JSON document) field by field and exits NONZERO on
+regression — the repo's first perf guardrail that runs hermetically:
+
+* **deterministic work counters** (``obs.profiler.WORK_COUNTERS``:
+  ``flops``, ``kv_bytes_touched``, ``dispatches``, ``recompiles_total``,
+  ``host_syncs``, ``pages_mapped``, ``pages_cow``, HBM byte counters)
+  are compared ALWAYS and EXACTLY by default (``--counter-threshold
+  0``): they are computed from host bookkeeping, so two runs of the same
+  workload must agree bit-for-bit even with no device attached — any
+  increase is a regression (more work per token), as is a counter that
+  vanished from the new artifact (a silently-dropped guard).
+* **measured latency fields** (``*tpot*``/``*ttft*``/``*queue_wait*``/
+  ``*prefill*``/``*transfer*``/``*wall*``/``*_ms``/``*_s`` names) are
+  compared where PRESENT IN BOTH artifacts: an increase beyond the
+  relative threshold (default 10%) is a regression.
+* **throughput fields** (``*goodput*``/``*tok_s*``/``*tokens_per_sec*``/
+  ``*mfu*``) regress when they DECREASE beyond the threshold.
+
+Per-field overrides: ``--threshold NAME=FRAC`` (matched against the leaf
+key).  Fields matching none of the classes are ignored — the comparator
+guards cost, not content.  Output is one JSON document (``ok``,
+``regressions``, ``improvements``, ``compared``); exit code 1 on any
+regression, 0 otherwise.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# leaf-key name classes (lowercase substring/regex matching)
+_COUNTER_KEYS = None  # loaded from obs.profiler.WORK_COUNTERS lazily
+_LATENCY_RE = re.compile(
+    r"(tpot|ttft|queue_wait|prefill(?!_tokens)|transfer|wall|downtime"
+    r"|latency|overhead)", re.I)
+_THROUGHPUT_RE = re.compile(r"(goodput|tokens_per_sec|tok_s|mfu)", re.I)
+_TIME_SUFFIX_RE = re.compile(r"_(ms|s|us)$")
+
+
+def _counter_keys():
+    global _COUNTER_KEYS
+    if _COUNTER_KEYS is None:
+        from flexflow_tpu.obs.profiler import WORK_COUNTERS
+
+        _COUNTER_KEYS = frozenset(WORK_COUNTERS)
+    return _COUNTER_KEYS
+
+
+def classify(leaf_key: str):
+    """'counter' | 'latency' | 'throughput' | None for one leaf key."""
+    if leaf_key in _counter_keys():
+        return "counter"
+    if _THROUGHPUT_RE.search(leaf_key):
+        return "throughput"
+    if _LATENCY_RE.search(leaf_key) and (
+            _TIME_SUFFIX_RE.search(leaf_key)
+            or "ticks" in leaf_key or "frac" in leaf_key):
+        return "latency"
+    return None
+
+
+def walk(doc, prefix=""):
+    """Yield (dotted_path, leaf_key, numeric_value) for every numeric
+    leaf (bools excluded; list indices join the path)."""
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            yield from walk(v, f"{prefix}.{k}" if prefix else str(k))
+    elif isinstance(doc, (list, tuple)):
+        for i, v in enumerate(doc):
+            yield from walk(v, f"{prefix}[{i}]")
+    elif isinstance(doc, bool):
+        return
+    elif isinstance(doc, (int, float)):
+        leaf = prefix.rsplit(".", 1)[-1]
+        leaf = re.sub(r"\[\d+\]$", "", leaf)
+        yield prefix, leaf, float(doc)
+
+
+def compare(old: dict, new: dict, default_threshold: float = 0.10,
+            counter_threshold: float = 0.0,
+            overrides=None) -> dict:
+    """Pure comparison (importable by tests and CI wrappers): returns
+    ``{"ok", "regressions", "improvements", "compared", "missing"}``."""
+    overrides = overrides or {}
+    old_leaves = {path: (leaf, v) for path, leaf, v in walk(old)}
+    new_leaves = {path: (leaf, v) for path, leaf, v in walk(new)}
+    regressions, improvements, missing = [], [], []
+    compared = 0
+    for path, (leaf, v_old) in sorted(old_leaves.items()):
+        kind = classify(leaf)
+        if kind is None:
+            continue
+        if path not in new_leaves:
+            if kind == "counter":
+                # a deterministic guard field that vanished IS a
+                # regression: the new run no longer proves its work
+                missing.append({"field": path, "kind": kind,
+                                "old": v_old})
+            continue
+        v_new = new_leaves[path][1]
+        compared += 1
+        thr = overrides.get(leaf,
+                            counter_threshold if kind == "counter"
+                            else default_threshold)
+        if v_old == 0:
+            delta = 0.0 if v_new == 0 else float("inf")
+        else:
+            delta = (v_new - v_old) / abs(v_old)
+        worse = delta > thr if kind != "throughput" else (-delta) > thr
+        better = delta < -thr if kind != "throughput" else delta > thr
+        entry = {"field": path, "kind": kind, "old": v_old, "new": v_new,
+                 "delta_frac": (round(delta, 4)
+                                if delta != float("inf") else None),
+                 "threshold": thr}
+        if worse:
+            regressions.append(entry)
+        elif better:
+            improvements.append(entry)
+    regressions.extend(missing)
+    return {
+        "ok": not regressions,
+        "compared": compared,
+        "regressions": regressions,
+        "improvements": improvements,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two bench artifacts; exit nonzero on regression")
+    ap.add_argument("old", help="reference artifact (JSON)")
+    ap.add_argument("new", help="candidate artifact (JSON)")
+    ap.add_argument("--default-threshold", type=float, default=0.10,
+                    help="relative threshold for measured fields "
+                         "(default 0.10)")
+    ap.add_argument("--counter-threshold", type=float, default=0.0,
+                    help="relative threshold for deterministic work "
+                         "counters (default 0 = exact)")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="FIELD=FRAC",
+                    help="per-field override (leaf key), repeatable")
+    ap.add_argument("--indent", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    overrides = {}
+    for spec in args.threshold:
+        field, _, frac = spec.partition("=")
+        if not frac:
+            ap.error(f"--threshold needs FIELD=FRAC, got {spec!r}")
+        overrides[field] = float(frac)
+
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    result = compare(old, new, args.default_threshold,
+                     args.counter_threshold, overrides)
+    result["old"] = args.old
+    result["new"] = args.new
+    print(json.dumps(result, indent=args.indent))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,16 @@ workload-drift score + per-dimension window means, ``drift_detected`` /
 ``replan_recommended`` events, and the CalibrationStore scales that were
 auto-applied to the search's predictions.
 
+The ``time_budget`` section is the step-level cost attribution view
+(obs/profiler.py, present when a ``StepProfiler`` was bound to the
+exporting handle): per-phase host time totals/fractions (host_prepare /
+dispatch / per-stage + hop / readback), the deterministic work counters
+(flops, KV bytes touched, dispatches, jit recompiles, host syncs, pages
+mapped/COW'd — the ``scripts/bench_compare.py`` guardrail fields), and
+the per-plan per-COMPONENT predicted-vs-executed error table
+(``attention_ms`` ... ``host_overhead_ms``) whose ``suggested_scale``
+entries feed component-level ``MachineModel``/search calibration.
+
 The ``memory`` section is the byte-side view (obs/memory.py): live KV
 watermarks (``hwm_frac`` vs capacity), occupancy p50/p95, the
 ``kv_*`` gauge values, per-request ``request_kv_bytes`` attribution, the

@@ -1,0 +1,152 @@
+"""scripts/bench_compare.py: the hermetic perf-regression guardrail.
+
+Deterministic work counters (obs/profiler.WORK_COUNTERS) diff EXACTLY —
+any increase (or a vanished counter) exits nonzero; measured latency /
+throughput fields diff against relative thresholds with direction
+(latency up = bad, throughput down = bad).  Identical artifacts exit 0.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SCRIPT = os.path.join(REPO, "scripts", "bench_compare.py")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench_compare  # noqa: E402
+
+DOC = {
+    "serving_under_load": {
+        "0.5x": {
+            "ttft_p50_ms": 12.0,
+            "tpot_p50_ms": 7.0,
+            "goodput_tokens_per_sec": 900.0,
+            "work": {"flops": 1.5e9, "kv_bytes_touched": 2.0e6,
+                     "dispatches": 42},
+            "step_profile": {"recompiles_total": 3, "host_syncs": 17},
+        },
+    },
+    "note": "strings and bools are ignored",
+    "bit_identical": True,
+}
+
+
+def run_cli(old_doc, new_doc, tmp_path, *extra):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(old_doc))
+    new.write_text(json.dumps(new_doc))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(old), str(new), *extra],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    return proc.returncode, json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_identical_artifacts_pass(tmp_path):
+    rc, res = run_cli(DOC, DOC, tmp_path)
+    assert rc == 0 and res["ok"]
+    assert res["regressions"] == []
+    assert res["compared"] > 0
+
+
+def test_counter_regression_fails_exactly(tmp_path):
+    new = copy.deepcopy(DOC)
+    # one extra dispatch: deterministic counters are exact by default
+    new["serving_under_load"]["0.5x"]["work"]["dispatches"] = 43
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 1 and not res["ok"]
+    [reg] = res["regressions"]
+    assert reg["field"].endswith("work.dispatches")
+    assert reg["kind"] == "counter"
+    assert reg["old"] == 42 and reg["new"] == 43
+
+
+def test_recompile_regression_fails(tmp_path):
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["step_profile"][
+        "recompiles_total"] = 9
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 1
+    assert any(r["field"].endswith("recompiles_total")
+               for r in res["regressions"])
+
+
+def test_counter_improvement_is_not_a_regression(tmp_path):
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["work"]["flops"] = 1.0e9  # less work
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 0
+    assert any(i["field"].endswith("work.flops")
+               for i in res["improvements"])
+
+
+def test_missing_counter_is_a_regression(tmp_path):
+    new = copy.deepcopy(DOC)
+    del new["serving_under_load"]["0.5x"]["work"]
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 1
+    missing = [r for r in res["regressions"] if "new" not in r]
+    assert any(r["field"].endswith("work.flops") for r in missing)
+
+
+def test_latency_threshold_and_direction(tmp_path):
+    # +5% TPOT: inside the default 10% threshold
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["tpot_p50_ms"] = 7.35
+    rc, _ = run_cli(DOC, new, tmp_path)
+    assert rc == 0
+    # +20% TPOT: regression
+    new["serving_under_load"]["0.5x"]["tpot_p50_ms"] = 8.4
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 1
+    assert any(r["field"].endswith("tpot_p50_ms")
+               for r in res["regressions"])
+    # -20% TPOT: improvement, not regression
+    new["serving_under_load"]["0.5x"]["tpot_p50_ms"] = 5.6
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 0
+    assert any(i["field"].endswith("tpot_p50_ms")
+               for i in res["improvements"])
+
+
+def test_throughput_direction_is_inverted(tmp_path):
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["goodput_tokens_per_sec"] = 700.0
+    rc, res = run_cli(DOC, new, tmp_path)
+    assert rc == 1
+    [reg] = [r for r in res["regressions"]
+             if r["field"].endswith("goodput_tokens_per_sec")]
+    assert reg["kind"] == "throughput"
+    # higher goodput is fine
+    new["serving_under_load"]["0.5x"]["goodput_tokens_per_sec"] = 1100.0
+    rc, _ = run_cli(DOC, new, tmp_path)
+    assert rc == 0
+
+
+def test_per_field_threshold_override(tmp_path):
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["tpot_p50_ms"] = 7.35  # +5%
+    rc, _ = run_cli(DOC, new, tmp_path, "--threshold", "tpot_p50_ms=0.03")
+    assert rc == 1
+    # and counters can be given slack explicitly
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["work"]["flops"] = 1.5e9 * 1.01
+    rc, _ = run_cli(DOC, new, tmp_path)
+    assert rc == 1
+    rc, _ = run_cli(DOC, new, tmp_path, "--counter-threshold", "0.05")
+    assert rc == 0
+
+
+def test_compare_importable_and_measured_only_where_present():
+    """Measured fields present in only one artifact are skipped (not
+    regressions); deterministic counters are the strict class."""
+    old = {"tpot_p50_ms": 7.0, "extra_latency_ms": 3.0}
+    new = {"tpot_p50_ms": 7.0}
+    res = bench_compare.compare(old, new)
+    assert res["ok"] and res["compared"] == 1

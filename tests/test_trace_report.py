@@ -288,6 +288,83 @@ def test_dry_run_live_migration_roundtrips(dryrun):
     assert reported == s, "trace_report.py diverged on migration events"
 
 
+def test_dry_run_step_profile_reconciles_per_component(dryrun):
+    """ISSUE 13 acceptance: a machine model skewed on ONE component (hop
+    time x2.5) yields a component-level ``suggested_scale`` that corrects
+    only that component's prediction error (error_frac drops below 0.1
+    for the skewed component, others unchanged) — and the profiled tiny
+    serve is bit-identical with the profiler on, its time budget riding
+    the real schema through ``scripts/trace_report.py``."""
+    _, doc = dryrun
+    sp = doc["observability"]["step_profile"]
+    assert sp["bit_identical"], "profiler changed dry-run serve outputs"
+
+    rec = sp["reconciliation"]
+    assert rec["skewed_component"] == "hop_ms"
+    scales = rec["suggested_scales"]
+    assert scales["hop_ms"] == pytest.approx(2.5, abs=0.01)
+    for c, s in scales.items():
+        if c != "hop_ms":
+            assert s == pytest.approx(1.0, abs=0.01), c
+    # before: only the hop is mispriced; after the store's component
+    # scales apply, the hop error collapses and the others are untouched
+    assert abs(rec["error_frac_before"]["hop_ms"]) > 0.3
+    assert abs(rec["error_frac_after"]["hop_ms"]) < 0.1
+    for c in rec["error_frac_before"]:
+        if c != "hop_ms":
+            assert rec["error_frac_after"][c] == pytest.approx(
+                rec["error_frac_before"][c], abs=1e-6), c
+    assert os.path.exists(rec["store_path"])
+    # search_serve_plan consulted the same component scales directly
+    assert rec["search_applied_scales"]["hop_ms"] == scales["hop_ms"]
+
+    # the profiled serve accumulated real phase/counter content
+    work = sp["profiler"]["work"]
+    assert work["flops"] > 0 and work["dispatches"] > 0
+    assert work["host_syncs"] > 0
+    tb = sp["summary"]["time_budget"]
+    assert tb["ticks"] == sp["profiler"]["ticks"]
+    assert tb["work"] == work
+    assert "dispatch" in tb["phases"] and "host_prepare" in tb["phases"]
+    # the per-component error table rode the calibration line
+    assert tb["components"]["tp1_pp2_m1"]["hop_ms"]["error_frac"] \
+        == pytest.approx(1.5, abs=0.01)
+
+    # the CLI reproduces the summary (time budget included) from the file
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         sp["paths"]["jsonl"]]))
+    assert reported == sp["summary"]
+    assert reported["time_budget"] == tb
+
+
+def test_dry_run_artifact_guards_with_bench_compare(dryrun, tmp_path):
+    """The regression comparator is the loop's guardrail: the dry-run
+    section compares clean against itself and trips on an injected
+    deterministic-counter regression."""
+    _, doc = dryrun
+    sp = doc["observability"]["step_profile"]
+    script = os.path.join(REPO, "scripts", "bench_compare.py")
+    ref = tmp_path / "ref.json"
+    ref.write_text(json.dumps(sp))
+    # identical artifacts: exit 0, no regressions
+    res = json.loads(_run([script, str(ref), str(ref)]))
+    assert res["ok"] and res["regressions"] == []
+    assert res["compared"] > 0
+    # injected counter regression (one silent recompile): exit nonzero
+    import copy
+
+    bad = copy.deepcopy(sp)
+    bad["profiler"]["work"]["recompiles_total"] += 1
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(bad))
+    proc = _run_raw([script, str(ref), str(cand)])
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert any(r["field"].endswith("recompiles_total")
+               for r in out["regressions"])
+
+
 def test_check_mode_validates_dry_run_schema(dryrun):
     out, doc = dryrun
     script = os.path.join(REPO, "scripts", "trace_report.py")
@@ -296,7 +373,8 @@ def test_check_mode_validates_dry_run_schema(dryrun):
                   doc["observability"]["memory_ledger"]["paths"]["jsonl"],
                   doc["observability"]["shared_prefix"]["paths"]["jsonl"],
                   doc["observability"]["spec_serving"]["paths"]["jsonl"],
-                  doc["observability"]["live_migration"]["paths"]["jsonl"]):
+                  doc["observability"]["live_migration"]["paths"]["jsonl"],
+                  doc["observability"]["step_profile"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
