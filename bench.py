@@ -2089,6 +2089,110 @@ def step_profile_dryrun(out_dir=None):
     }
 
 
+def fleet_serving_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` fleet-serving section (serve/fleet.py): a
+    REAL 3-replica fleet on the virtual clock serving one open-loop
+    arrival stream twice — fault-free, then with one replica KILLED
+    MID-DECODE — demonstrating the robustness acceptance contract with
+    no device work:
+
+    * **every request reaches a terminal outcome** in the chaos run
+      (the dead replica's in-flight requests fail over to survivors);
+    * **bit-identity**: every request's token stream in the chaos run
+      equals the fault-free run token-for-token — failover is the r9
+      recompute path under the ORIGINAL rid, so the (rid, token_index)
+      sample fold crosses replicas;
+    * **refcount no-leak**: the dead replica's ``KVAllocator.teardown``
+      released zero still-attributed rids;
+    * **goodput delta**: fleet-aggregate goodput of the chaos run vs
+      fault-free, stamped alongside per-replica + fleet TTFT/TPOT and
+      the outcome mix (``under_load_summary``'s multi-worker extension).
+
+    The exported JSONL carries the new fleet vocabulary (``replica_*``
+    health instants, ``request_failed_over``, ``FLEET_COUNTERS``)
+    through the real schema and round-trips through
+    ``scripts/trace_report.py`` (``--check`` clean); the section's
+    deterministic fleet counters join ``scripts/bench_compare.py``'s
+    exact-compare class, so two runs of this workload diff clean and a
+    failover/quarantine/death increase trips the guardrail.
+    """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl, under_load_summary
+    from flexflow_tpu.serve import FleetRouter, GenerationConfig
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    gen_args = dict(max_new_tokens=8)
+    rng = np.random.RandomState(11)
+    arrivals = [
+        (0.004 * i,
+         [int(x) for x in rng.randint(1, 63, size=rng.randint(3, 8))], 8)
+        for i in range(8)
+    ]
+
+    def tiny_im():
+        return build_im(False, layers=2, hidden=32, heads=2, kv=2, inter=48,
+                        vocab=64, max_requests=2, max_seq=64, max_tokens=16)
+
+    def run(telemetry=None, kill=None):
+        fleet = FleetRouter([tiny_im() for _ in range(3)],
+                            gen=GenerationConfig(**gen_args),
+                            telemetry=telemetry)
+        if kill is not None:
+            fleet.schedule_kill(*kill)
+        records = fleet.serve_with_arrivals(list(arrivals), clock=_Tick())
+        return fleet, records
+
+    # fault-free reference of the SAME arrival stream (rids match by
+    # construction: one fleet rid space, arrival order fixed)
+    _, rec_ok = run()
+    tokens_ok = {rid: r["tokens"] for rid, r in rec_ok.items()}
+    summary_ok = under_load_summary(rec_ok)
+
+    # chaos run: replica1 dies mid-decode (tick 4 lands inside the decode
+    # phase of the early arrivals on the virtual clock)
+    tel = Telemetry(clock=_Tick())
+    fleet, rec_kill = run(telemetry=tel, kill=("replica1", 4))
+    tokens_kill = {rid: r["tokens"] for rid, r in rec_kill.items()}
+    summary_kill = under_load_summary(rec_kill)
+    dead = fleet._by_name("replica1")
+    snap = tel.metrics.snapshot()
+
+    paths = tel.export(out_dir, prefix="dryrun_fleet")
+    report = summarize_jsonl(paths["jsonl"])
+    goodput_ok = summary_ok.get("goodput_tokens_per_sec") or 0.0
+    goodput_kill = summary_kill.get("goodput_tokens_per_sec") or 0.0
+    return {
+        "paths": paths,
+        "summary": report,
+        "replicas": 3,
+        "requests": len(arrivals),
+        "bit_identical": tokens_kill == tokens_ok,
+        "all_terminal": all(r.get("outcome") for r in rec_kill.values()),
+        "outcomes": summary_kill["outcomes"],
+        "failovers": summary_kill.get("failovers", 0),
+        "failovers_total": snap.get("failovers_total"),
+        "replica_deaths": snap.get("replica_deaths"),
+        "kv_leak_free": dead.leaked == [],
+        "under_load": {"fault_free": summary_ok, "replica_killed":
+                       summary_kill},
+        "goodput": {
+            "fault_free_tok_s": goodput_ok,
+            "replica_killed_tok_s": goodput_kill,
+            "delta_frac": (round((goodput_kill - goodput_ok) / goodput_ok, 4)
+                           if goodput_ok else None),
+        },
+        "note": "real 3-replica fleet on the virtual clock: one arrival "
+                "stream served fault-free and with replica1 killed "
+                "mid-decode — failed-over requests recompute on survivors "
+                "under their original rids (token streams bit-identical "
+                "to the fault-free fleet), every request terminal, dead "
+                "replica tears down refcount-clean; goodput delta is the "
+                "price of losing a third of the fleet",
+    }
+
+
 def bench_shared_prefix(ctx=256, n_users=16, shared_len=1536,
                         suffix_len=128, max_new=32, page=512):
     """DEVICE shared-prefix serving section: N users x one system prompt,
@@ -2167,6 +2271,8 @@ def main(argv=None):
         doc["observability"]["live_migration"] = live_migration_dryrun(
             args.out)
         doc["observability"]["step_profile"] = step_profile_dryrun(args.out)
+        doc["observability"]["fleet_serving"] = fleet_serving_dryrun(
+            args.out)
         print(json.dumps(doc))
         return
 

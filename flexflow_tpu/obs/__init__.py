@@ -32,7 +32,7 @@ from .metrics import (
     MetricsRegistry,
     percentile,
 )
-from .plan_health import PlanHealthConfig, PlanHealthMonitor
+from .plan_health import PlanHealthConfig, PlanHealthMonitor, health_score
 from .profiler import (
     COMPONENTS,
     NULL_PROFILER,

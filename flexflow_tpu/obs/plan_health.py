@@ -122,6 +122,10 @@ class PlanHealthMonitor:
         self.kv_allocator = kv_allocator
         self.checks = 0
         self.recommendation: Optional[Dict] = None
+        # the most recent check() report — the fleet router's least-load
+        # dispatch reads it (via health_score) so an unhealthy replica's
+        # routing weight degrades without re-running the checks per tick
+        self.last_report: Optional[Dict] = None
         self._last_candidate_key: Optional[str] = None
         self._last_emit_check: Optional[int] = None
         self._mem_pressure_active = False
@@ -335,4 +339,17 @@ class PlanHealthMonitor:
         if not reasons:
             # condition cleared: a future excursion may re-emit
             self._last_candidate_key = None
+        self.last_report = report
         return report
+
+
+def health_score(report: Optional[Dict]) -> float:
+    """Routing penalty derived from a health report (None/healthy = 0.0;
+    +1 per breached check reason).  The fleet router
+    (``serve/fleet.py``) adds it to a replica's least-load score so a
+    replica whose attached monitor reports SLO misses, drift, or memory
+    pressure attracts fewer new dispatches — host-side arithmetic only,
+    no effect without an attached monitor."""
+    if not report:
+        return 0.0
+    return float(len(report.get("reasons", ()) or ()))

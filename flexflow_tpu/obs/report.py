@@ -49,6 +49,11 @@ _MEMPRESS = "memory_pressure"
 # replan_recommended — start / completion / rollback of a plan switch
 _MIG_EVENTS = ("migration_started", "migration_completed",
                "migration_rolled_back")
+# fault-tolerant fleet serving (serve/fleet.py): replica health-state
+# transitions + per-request failover onto a survivor
+_FLEET_EVENTS = ("replica_up", "replica_degraded", "replica_quarantined",
+                 "replica_dead")
+_FAILOVER = "request_failed_over"
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -76,6 +81,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     replans: List[Dict] = []
     mem_pressure: List[Dict] = []
     migrations: Dict[str, List[Dict]] = {n: [] for n in _MIG_EVENTS}
+    fleet_events: Dict[str, List[Dict]] = {n: [] for n in _FLEET_EVENTS}
+    failovers: List[Dict] = []
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -113,6 +120,12 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name in migrations:
             migrations[name].append(ev.get("args", {}))
+            continue
+        if name in fleet_events:
+            fleet_events[name].append(ev.get("args", {}))
+            continue
+        if name == _FAILOVER:
+            failovers.append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -187,6 +200,13 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             "completed": migrations["migration_completed"],
             "rolled_back": migrations["migration_rolled_back"],
         },
+        # fault-tolerant fleet serving: replica health transitions +
+        # per-request failovers (serve/fleet.py)
+        "fleet": {
+            "replica_events": {n.replace("replica_", ""): fleet_events[n]
+                               for n in _FLEET_EVENTS},
+            "failed_over": failovers,
+        },
     }
 
 
@@ -252,6 +272,15 @@ def summarize_jsonl(path: str) -> Dict:
     # above, not here
     summary["migrations"]["counters"] = {
         k: metrics[k] for k in MIGRATION_COUNTERS if k in metrics}
+    # fleet view: the replica health transitions summarize_events already
+    # collected, joined with the exact registry counters/gauges
+    # (FLEET_COUNTERS — failovers_total and the replica_* counters are
+    # cumulative and survive trace-ring drops; the fleet_replicas_*
+    # gauges carry the LAST fleet tick's values)
+    from .telemetry import FLEET_COUNTERS
+
+    summary["fleet"]["counters"] = {
+        k: metrics[k] for k in FLEET_COUNTERS if k in metrics}
 
     pred_err: Dict[str, Dict] = {}
     for plan, fields in calibration.get("plans", {}).items():
@@ -438,7 +467,8 @@ def validate_jsonl(path: str) -> List[str]:
             err(i, "counter event missing args.value")
         # typed vocabulary: the categories the report parses semantically
         cat = doc.get("cat")
-        if ph == "i" and cat in ("request", "dispatch", "plan", "profile"):
+        if ph == "i" and cat in ("request", "dispatch", "plan", "profile",
+                                 "fleet"):
             name = doc["name"]
             schema = EVENT_SCHEMA.get(name)
             if schema is None:
@@ -456,13 +486,20 @@ def validate_jsonl(path: str) -> List[str]:
     return errors
 
 
-def under_load_summary(records: Dict, makespan_s: Optional[float] = None
-                       ) -> Dict:
+def under_load_summary(records: Dict, makespan_s: Optional[float] = None,
+                       per_replica: bool = True) -> Dict:
     """Reduce ``RequestManager.serve_with_arrivals`` records to the
     ``serving_under_load`` fields: TTFT distribution (split into queue wait
     vs prefill where the records carry the split), per-request TPOT
     p50/p95, goodput.  Pure host-side math — the hermetic small-shape test
-    (tests/test_serving_under_load.py) runs it on a virtual clock."""
+    (tests/test_serving_under_load.py) runs it on a virtual clock.
+
+    Multi-worker records (``FleetRouter.serve_with_arrivals`` stamps the
+    serving replica into each record's ``replica`` field, plus
+    per-request ``failovers``) additionally get a ``per_replica``
+    breakdown — the same reduction per serving replica, sharing the
+    fleet-wide makespan so per-replica goodputs SUM to the fleet
+    aggregate — and a total ``failovers`` count."""
     recs = list(records.values())
     outcomes: Dict[str, int] = {}
     for r in recs:
@@ -495,6 +532,20 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None
 
         work = {k: sum(w.get(k, 0) for w in work_recs)
                 for k in REQUEST_WORK_COUNTERS}
+    # fleet breakdown: group by the serving replica (rejected-before-
+    # placement records group under ""), reduce each group with the SAME
+    # accounting and the fleet-wide makespan
+    replica_summary = None
+    failover_total = None
+    if per_replica and any("replica" in r for r in recs):
+        groups: Dict[str, Dict] = {}
+        for rid, r in records.items():
+            groups.setdefault(r.get("replica", ""), {})[rid] = r
+        replica_summary = {
+            name: under_load_summary(group, makespan_s=makespan,
+                                     per_replica=False)
+            for name, group in sorted(groups.items())}
+        failover_total = sum(r.get("failovers", 0) for r in recs)
     return {
         "requests": len(recs),
         "completed": len(done),
@@ -510,4 +561,8 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None
                                    if makespan else None),
         "outcomes": outcomes,
         **({"work": work} if work is not None else {}),
+        **({"per_replica": replica_summary}
+           if replica_summary is not None else {}),
+        **({"failovers": failover_total}
+           if failover_total is not None else {}),
     }

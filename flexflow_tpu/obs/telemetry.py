@@ -98,6 +98,18 @@ EVENT_SCHEMA = {
     # args carry the tick index plus the tick's deterministic work-counter
     # deltas (flops, kv_bytes_touched, dispatches, ...)
     "step_profile": ("profile", ("tick",)),
+    # fault-tolerant fleet serving (serve/fleet.py): the per-replica
+    # health state machine's transitions (HEALTHY -> DEGRADED ->
+    # QUARANTINED -> DEAD, plus readmission back to HEALTHY after a
+    # successful quarantine re-probe) and the failover of one request off
+    # a failed replica onto a survivor (original rid preserved — the
+    # recompute is bit-identical by the r9 sample-fold contract)
+    "replica_up": ("fleet", ("replica",)),
+    "replica_degraded": ("fleet", ("replica",)),
+    "replica_quarantined": ("fleet", ("replica",)),
+    "replica_dead": ("fleet", ("replica",)),
+    "request_failed_over": ("request", ("trace_id", "from_replica",
+                                        "to_replica")),
 }
 
 # migration counter/gauge vocabulary (report.py folds these into the
@@ -109,6 +121,30 @@ EVENT_SCHEMA = {
 MIGRATION_COUNTERS = (
     "migrations_completed", "migrations_rolled_back",
     "migration_downtime_ticks", "migration_preempted_requests",
+)
+
+# fleet counter/gauge vocabulary (serve/fleet.py; report.py folds these
+# into the ``fleet`` summary section — one tuple shared by the emitters,
+# the report, and the bench dry-run so a renamed metric cannot silently
+# drop from any of them).  The ``replica_*``/``failovers_total`` entries
+# are exact cumulative counters; ``fleet_replicas_healthy`` /
+# ``fleet_replicas_alive`` / ``fleet_queue_depth`` are gauges the router
+# publishes every fleet tick.
+FLEET_COUNTERS = (
+    "failovers_total", "replica_ups", "replica_degradations",
+    "replica_quarantines", "replica_deaths",
+    "fleet_replicas_healthy", "fleet_replicas_alive",
+    "fleet_replicas_total", "fleet_queue_depth",
+)
+
+# the monotone bad-if-increasing subset scripts/bench_compare.py treats
+# like deterministic WORK_COUNTERS (exact compare, any increase between
+# two runs of the same workload is a regression — more replicas failing
+# per served token); the health gauges stay out (a gauge's direction is
+# not monotone-bad, so exact-compare semantics would invert)
+FLEET_REGRESSION_COUNTERS = (
+    "failovers_total", "replica_degradations", "replica_quarantines",
+    "replica_deaths",
 )
 
 
@@ -330,6 +366,65 @@ class Telemetry:
             incumbent=incumbent, candidate=candidate, phase=phase,
             reason=reason)
 
+    # ---- fault-tolerant fleet serving (serve/fleet.py) -----------------
+    def replica_up(self, replica: str, reason: str = "") -> float:
+        """A replica joined (or re-joined, after a successful quarantine
+        re-probe) the dispatch rotation in the HEALTHY state."""
+        self.metrics.counter("replica_ups").inc()
+        return self.trace.instant("replica_up", "fleet", "fleet",
+                                  replica=replica, reason=reason)
+
+    def replica_degraded(self, replica: str, reason: str = "") -> float:
+        """Dispatch failures pushed a replica to DEGRADED: it keeps
+        serving its in-flight requests but new dispatches avoid it."""
+        self.metrics.counter("replica_degradations").inc()
+        return self.trace.instant("replica_degraded", "fleet", "fleet",
+                                  replica=replica, reason=reason)
+
+    def replica_quarantined(self, replica: str, reason: str = "") -> float:
+        """Consecutive failures quarantined a replica: its in-flight
+        requests failed over to survivors and it leaves the rotation
+        until a re-probe succeeds (or probes exhaust into DEAD)."""
+        self.metrics.counter("replica_quarantines").inc()
+        return self.trace.instant("replica_quarantined", "fleet", "fleet",
+                                  replica=replica, reason=reason)
+
+    def replica_dead(self, replica: str, reason: str = "",
+                     failed_over: int = 0) -> float:
+        """A replica is terminally dead (quarantine probes exhausted, or
+        an operator kill): its KV tore down (refcount no-leak asserted by
+        the chaos tests) and ``failed_over`` in-flight requests moved to
+        survivors through the r9 recompute path."""
+        self.metrics.counter("replica_deaths").inc()
+        return self.trace.instant("replica_dead", "fleet", "fleet",
+                                  replica=replica, reason=reason,
+                                  failed_over=failed_over)
+
+    def request_failed_over(self, trace_id: str, from_replica: str,
+                            to_replica: str) -> float:
+        """A request left a failed replica and re-dispatched onto a
+        survivor with its ORIGINAL rid — the recompute re-prefills
+        prompt+generated there, bit-identical for greedy AND seeded
+        sampling (the (rid, token_index) fold crosses replicas)."""
+        self.metrics.counter("failovers_total").inc()
+        return self.trace.instant("request_failed_over", "request",
+                                  "requests", trace_id=trace_id,
+                                  from_replica=from_replica,
+                                  to_replica=to_replica)
+
+    def fleet_health(self, healthy: int, alive: int, total: int,
+                     queue_depth: int) -> None:
+        """Per-fleet-tick health gauges: HEALTHY replicas, alive
+        (HEALTHY + DEGRADED) replicas, the built fleet size, and the
+        shared admission queue's depth."""
+        m = self.metrics
+        m.gauge("fleet_replicas_healthy").set(healthy)
+        m.gauge("fleet_replicas_alive").set(alive)
+        m.gauge("fleet_replicas_total").set(total)
+        m.gauge("fleet_queue_depth").set(queue_depth)
+        self.trace.counter("fleet_replicas_healthy", healthy)
+        self.trace.counter("fleet_queue_depth", queue_depth)
+
     def spec_batch_mix(self, spec_requests: int, plain_requests: int) -> None:
         """One mixed verify macro-step's request composition: how many
         rows shipped a draft tree (multi-token verify) vs a root-only
@@ -539,6 +634,24 @@ class NullTelemetry:
 
     def migration_rolled_back(self, *a, **k):
         return 0.0
+
+    def replica_up(self, *a, **k):
+        return 0.0
+
+    def replica_degraded(self, *a, **k):
+        return 0.0
+
+    def replica_quarantined(self, *a, **k):
+        return 0.0
+
+    def replica_dead(self, *a, **k):
+        return 0.0
+
+    def request_failed_over(self, *a, **k):
+        return 0.0
+
+    def fleet_health(self, *a, **k):
+        return None
 
     def spec_batch_mix(self, *a, **k):
         return None

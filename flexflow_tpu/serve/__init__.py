@@ -45,7 +45,13 @@ from .resilience import (
     RetryPolicy,
     TransientServeError,
 )
-from .migration import MigrationConfig, MigrationController, MigrationRollback
+from .migration import (
+    MigrationConfig,
+    MigrationController,
+    MigrationRollback,
+    build_deployment,
+)
+from .fleet import FleetConfig, FleetRouter, ReplicaState
 from .spec_infer import SpecInferManager
 from .api import LLM, SSM
 from .weights import convert_state_dict, load_hf_model, place_params
@@ -79,6 +85,10 @@ __all__ = [
     "MigrationController",
     "MigrationConfig",
     "MigrationRollback",
+    "build_deployment",
+    "FleetRouter",
+    "FleetConfig",
+    "ReplicaState",
     "LLM",
     "SSM",
     "convert_state_dict",

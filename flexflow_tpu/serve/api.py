@@ -187,6 +187,26 @@ class LLM:
         return MigrationController(self.rm, build_manager, plan=plan,
                                    config=config, on_switch=on_switch)
 
+    @staticmethod
+    def fleet(llms, **kwargs):
+        """Build a fault-tolerant :class:`~flexflow_tpu.serve.fleet.
+        FleetRouter` over compiled ``LLM`` instances (each one replica
+        deployment — for bit-identity with a single-replica run they
+        must share weights and GenerationConfig).  Keyword args forward
+        to the router (``gen``/``telemetry``/``resilience``/
+        ``fault_injector``/``clock``/``profiler``/``config``); the
+        router then owns the shared admission queue, telemetry-driven
+        least-load dispatch, the per-replica health state machine with
+        bit-identical failover, and rolling plan migration — see
+        ``serve/fleet.py``."""
+        from .fleet import FleetRouter
+
+        rms = []
+        for llm in llms:
+            assert llm.rm is not None, "compile() every fleet member first"
+            rms.append(llm.rm)
+        return FleetRouter(rms, **kwargs)
+
     def memory_report(self):
         """The deployment's byte-side view NOW: the
         :class:`~flexflow_tpu.serve.kv_allocator.KVAllocator`'s live

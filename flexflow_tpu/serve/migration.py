@@ -100,6 +100,46 @@ class MigrationRollback(Exception):
     (never retried — retry is for :class:`TransientServeError` only)."""
 
 
+def build_deployment(built, gen, telemetry=None, resilience=None,
+                     fault_injector=None, clock=None, profiler=None,
+                     spec: Optional[Dict] = None, plan_key: str = "",
+                     default_shape: Optional[Tuple[int, int]] = None):
+    """Wrap a ``build_manager``-style result into a serving manager.
+
+    THE one wrapping contract shared by :class:`MigrationController`'s
+    rebuild phase and the fleet router's replica construction
+    (``serve/fleet.py``) — a deployment is a ready
+    :class:`~.request_manager.RequestManager` (returned as-is), a single
+    InferenceManager-like object (wrapped in a ``RequestManager``), or an
+    ``(llm_im, ssm_im)`` pair (wrapped in a
+    :class:`~.spec_infer.SpecInferManager`, tree shape resolved PER FIELD
+    from the ``spec`` dict, then the ``plan_key``'s ``_spec_w{w}d{d}``
+    suffix, then ``default_shape``).  Sharing gen/telemetry/resilience/
+    injector/clock/profiler here is what makes seeded bit-identity hold
+    by construction across managers — every wrapped deployment samples
+    through the same (seed, rid, token_index) schedule.
+    """
+    if isinstance(built, RequestManager):
+        return built
+    if isinstance(built, (tuple, list)):
+        from .spec_infer import SpecInferManager
+
+        llm_im, ssm_im = built
+        shape = dict(spec or {})
+        key_wd = spec_shape(plan_key)
+        base_wd = key_wd or default_shape or (2, 3)
+        width = shape.get("width") or base_wd[0]
+        depth = shape.get("depth") or base_wd[1]
+        return SpecInferManager(
+            llm_im, ssm_im, gen, width=width, depth=depth,
+            telemetry=telemetry, resilience=resilience,
+            fault_injector=fault_injector, clock=clock, profiler=profiler)
+    return RequestManager(built, gen, telemetry=telemetry,
+                          resilience=resilience,
+                          fault_injector=fault_injector, clock=clock,
+                          profiler=profiler)
+
+
 @dataclasses.dataclass
 class MigrationConfig:
     """Policy knobs for the live-migration controller.
@@ -427,35 +467,23 @@ class MigrationController:
                     raise MigrationRollback(
                         "build_manager must construct a FRESH deployment "
                         "(the incumbent's buffers are torn down on commit)")
-        if isinstance(built, RequestManager):
-            return built
         tel = rm.telemetry if rm.telemetry.enabled else None
         # the StepProfiler handle crosses the switch like telemetry: rids
         # are preserved, so the per-request work attribution keeps
         # accumulating in ONE table across managers (and the successor's
-        # jitted programs join the recompile poll via install())
+        # jitted programs join the recompile poll via install()).  Tree
+        # shape for a spec pair: candidate's spec dict, then the plan-key
+        # suffix, then the incumbent's shape (build_deployment resolves
+        # PER FIELD so a partial spec dict still fills in sanely).
         prof = rm.profiler if getattr(rm, "profiler", None) is not None \
             and rm.profiler.enabled else None
-        if isinstance(built, (tuple, list)):
-            from .spec_infer import SpecInferManager
-
-            llm_im, ssm_im = built
-            # tree shape: candidate's spec dict, then the plan-key suffix,
-            # then the incumbent's shape — resolved PER FIELD so a partial
-            # spec dict (width without depth) still fills in sanely
-            shape = (candidate.get("spec") or {})
-            key_wd = spec_shape(candidate.get("plan_key", ""))
-            inc_wd = ((rm.width, rm.depth) if hasattr(rm, "width")
-                      else (2, 3))
-            width = shape.get("width") or (key_wd or inc_wd)[0]
-            depth = shape.get("depth") or (key_wd or inc_wd)[1]
-            return SpecInferManager(
-                llm_im, ssm_im, rm.gen, width=width, depth=depth,
-                telemetry=tel, resilience=rm.res,
-                fault_injector=rm.injector, clock=rm.clock, profiler=prof)
-        return RequestManager(built, rm.gen, telemetry=tel,
-                              resilience=rm.res, fault_injector=rm.injector,
-                              clock=rm.clock, profiler=prof)
+        return build_deployment(
+            built, rm.gen, telemetry=tel, resilience=rm.res,
+            fault_injector=rm.injector, clock=rm.clock, profiler=prof,
+            spec=candidate.get("spec"),
+            plan_key=candidate.get("plan_key", ""),
+            default_shape=((rm.width, rm.depth) if hasattr(rm, "width")
+                           else None))
 
     def _readmit(self, rm: RequestManager, new_rm: RequestManager,
                  candidate: Dict) -> int:

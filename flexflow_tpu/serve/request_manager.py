@@ -53,6 +53,31 @@ OUTCOMES = {
     RequestStatus.FAILED: "failed",
 }
 
+# per-request options an arrival tuple's 4th element may carry — ONE
+# vocabulary/coercion for every arrival-driven loop (RequestManager and
+# the fleet router), so adding an option here reaches both and a
+# malformed dict rejects identically instead of drifting
+ARRIVAL_OPTION_KEYS = frozenset({"priority", "ttl_s", "deadline_s", "spec"})
+
+
+def parse_arrival_options(rest) -> Tuple[Dict, Optional[str]]:
+    """Parse an arrival tuple's optional trailing options dict into
+    ``register_new_request`` kwargs.  Returns ``(opts, reject_reason)``
+    — malformed dicts (unknown keys, uncoercible values) yield a reject
+    reason so one bad arrival registers as ``REJECTED`` instead of
+    killing the serve loop."""
+    if not rest:
+        return {}, None
+    if not isinstance(rest[0], dict) or set(rest[0]) - ARRIVAL_OPTION_KEYS:
+        return {}, f"bad arrival options {rest[0]!r}"
+    try:
+        return {k: (int(v) if k == "priority"
+                    else bool(v) if k == "spec"
+                    else float(v))
+                for k, v in rest[0].items() if v is not None}, None
+    except (TypeError, ValueError):
+        return {}, f"bad arrival options {rest[0]!r}"
+
 
 @dataclasses.dataclass
 class Request:
@@ -600,6 +625,17 @@ class RequestManager:
     # to make its failures go terminal instead
     supports_recompute = True
 
+    # fleet failover hook (serve/fleet.py): when a dispatch exhausts its
+    # retry budget, an attached ``on_exhausted(rm, site, exc,
+    # affected_fn)`` may take over recovery — returning True means it
+    # handled the affected requests (the fleet router preempts them and
+    # fails them over to a surviving replica, so exhaustion on a dying
+    # replica never goes terminally ``FAILED``); returning False (or no
+    # hook — the default, pinned by tests/test_resilience.py) keeps the
+    # single-replica r9 behavior: requeue-on-this-manager or FAILED per
+    # ``res.on_dispatch_failure``.
+    on_exhausted = None
+
     def _rids_in_batch(self, bc) -> List[int]:
         """The rids whose tokens are actually IN a built batch (a slotted
         request can sit out a step, e.g. a prefill starved of budget —
@@ -661,6 +697,10 @@ class RequestManager:
                 if tel.enabled:
                     tel.fault_observed(site, detail=str(e))
                 if attempt >= pol.max_retries:
+                    hook = self.on_exhausted
+                    if hook is not None and hook(self, site, e,
+                                                 affected_fn):
+                        return None
                     self._fail_inflight(site, e, affected_fn)
                     return None
                 attempt += 1
@@ -1488,22 +1528,7 @@ class RequestManager:
                 # malformed arrivals — bad prompt shapes AND bad options
                 # dicts — register as REJECTED records instead of raising
                 # out of (and killing) the serve loop
-                opts, reject = {}, None
-                if rest:
-                    known = {"priority", "ttl_s", "deadline_s", "spec"}
-                    if (isinstance(rest[0], dict)
-                            and not set(rest[0]) - known):
-                        try:
-                            opts = {
-                                k: (int(v) if k == "priority"
-                                    else bool(v) if k == "spec"
-                                    else float(v))
-                                for k, v in rest[0].items() if v is not None}
-                        except (TypeError, ValueError):
-                            opts, reject = {}, \
-                                f"bad arrival options {rest[0]!r}"
-                    else:
-                        reject = f"bad arrival options {rest[0]!r}"
+                opts, reject = parse_arrival_options(rest)
                 rid = self.register_new_request(
                     prompt, mnt, reject_invalid=True,
                     reject_reason=reject, **opts)

@@ -133,6 +133,15 @@ class FaultInjector:
     the single-program step dispatch.  ``max_faults`` bounds the total
     injected count — the lever that makes a seeded chaos run terminate
     deterministically whatever the retry budget.
+
+    Instrumented site families: the manager dispatches (``step`` /
+    ``decode_scan`` / ``prefill_scan`` + the pp ``stage{i}``/``hop``
+    sites), the live-migration phases (``migration_drain`` /
+    ``migration_rebuild`` / ``migration_readmit``), and the fleet
+    router's per-replica sites (``fleet_dispatch:<name>`` — router →
+    replica connectivity, consulted before every replica tick — and
+    ``fleet_health:<name>``, the quarantine re-probe; see
+    ``serve/fleet.py``'s health state machine).
     """
 
     def __init__(self, seed: int = 0, p: float = 0.0,

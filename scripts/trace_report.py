@@ -35,6 +35,13 @@ ledger's analog of ``prediction_error`` — its ``suggested_scale`` feeds
 ``memory_pressure`` OOM-risk breach events the plan-health monitor
 emitted.
 
+The ``fleet`` section is the multi-replica view (serve/fleet.py):
+per-replica health-state transitions (``replica_up`` / ``degraded`` /
+``quarantined`` / ``dead``), ``request_failed_over`` events (a request
+moving off a failed replica onto a survivor under its original rid),
+and the exact ``FLEET_COUNTERS`` registry view (``failovers_total``,
+``replica_deaths``, the ``fleet_replicas_*`` gauges).
+
 A trace whose ring buffer dropped events is TRUNCATED — the summary is
 computed from what survived — so ``dropped > 0`` prints an explicit
 warning to stderr (satellite of ISSUE 6: a truncated trace must not

@@ -150,3 +150,22 @@ def test_compare_importable_and_measured_only_where_present():
     new = {"tpot_p50_ms": 7.0}
     res = bench_compare.compare(old, new)
     assert res["ok"] and res["compared"] == 1
+
+
+def test_fleet_counters_join_the_exact_compare_class():
+    """The fleet robustness counters (serve/fleet.py) diff like
+    deterministic work counters: exact by default, an increase is a
+    regression (more replicas failing per served token), a decrease is
+    an improvement — and the health GAUGES stay unclassified (their
+    direction is not monotone-bad)."""
+    for k in ("failovers_total", "replica_deaths", "replica_quarantines",
+              "replica_degradations"):
+        assert bench_compare.classify(k) == "counter", k
+    assert bench_compare.classify("fleet_replicas_healthy") is None
+    old = {"fleet": {"failovers_total": 1, "replica_deaths": 1}}
+    worse = {"fleet": {"failovers_total": 2, "replica_deaths": 1}}
+    res = bench_compare.compare(old, worse)
+    assert not res["ok"]
+    assert any(r["field"].endswith("failovers_total")
+               for r in res["regressions"])
+    assert bench_compare.compare(old, old)["ok"]

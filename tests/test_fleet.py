@@ -1,0 +1,455 @@
+"""Fault-tolerant multi-replica fleet serving (serve/fleet.py).
+
+The load-bearing contracts (ISSUE 14 acceptance):
+
+* **Bit-identity of survivors AND failed-over requests** — for greedy
+  and seeded sampling, killing a replica during prefill, mid-decode, or
+  during a rolling migration's drain leaves every request's token
+  stream equal to a fault-free run: failover is the r9 recompute path
+  under the ORIGINAL rid, and the (rid, token_index) sample fold
+  crosses replicas exactly as it crosses migration managers.
+* **Every request reaches a terminal outcome** — shed load under fleet
+  shrink ends in explicit ``REJECTED`` (never ``FAILED``), in-flight
+  work fails over, and the dead replica's ``KVAllocator.teardown``
+  releases zero still-attributed rids (refcount no-leak).
+* **The health state machine** — ``fleet_dispatch:<name>`` faults
+  degrade then quarantine a replica (its requests failing over), a
+  quarantined replica re-probes (``fleet_health:<name>``) and readmits,
+  and probe exhaustion retires it DEAD.
+* **Rolling migration never stops serving** — one replica drains at a
+  time, so all but one keep admission open at every tick.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.obs import Telemetry
+from flexflow_tpu.obs.report import under_load_summary, validate_jsonl
+from flexflow_tpu.parallel.mesh import make_mesh
+from flexflow_tpu.serve import (
+    FaultInjector,
+    FleetConfig,
+    FleetRouter,
+    GenerationConfig,
+    InferenceManager,
+    MigrationConfig,
+    ReplicaState,
+    RequestManager,
+    RequestStatus,
+    ResilienceConfig,
+    RetryPolicy,
+    build_model,
+)
+
+from test_serve import TINY
+from test_serving_under_load import VirtualClock
+
+pytestmark = pytest.mark.fleet
+
+PROMPTS = [[3, 5, 7, 9, 11], [2, 4, 6], [13, 8, 1]]
+LONG_PROMPT = [5, 3, 7, 2, 9, 4, 8, 6, 1, 11, 13, 10]  # spans prefill ticks
+
+
+def fresh_im(max_tokens=16, max_requests=2, max_seq=64, seed=7,
+             kv_page_size=None):
+    """A replica deployment with its OWN buffers/programs (test_serve's
+    ``make_im`` cache would alias two replicas onto one im).  Same seed
+    => identical weights across replicas — the fleet bit-identity
+    precondition."""
+    mesh = make_mesh({"tp": 1}, jax.devices()[:1])
+    ff = FFModel(FFConfig(), mesh=mesh)
+    build_model(ff, TINY, max_tokens)
+    im = InferenceManager(
+        ff, max_requests=max_requests, max_tokens_per_batch=max_tokens,
+        max_seq_len=max_seq, kv_page_size=kv_page_size)
+    im.init_operators_inference(rng=jax.random.PRNGKey(seed))
+    return im
+
+
+def greedy(max_new=8):
+    return GenerationConfig(max_new_tokens=max_new)
+
+
+def seeded(max_new=8):
+    return GenerationConfig(max_new_tokens=max_new, temperature=0.8,
+                            top_p=0.9, seed=5)
+
+
+_BASELINES = {}
+
+
+def baseline(gen_fn, prompts):
+    """Single-manager reference tokens (cached per gen/prompt set —
+    fleet serving must be bit-identical to it whatever the routing or
+    failure schedule, because tokens depend only on (weights, rid,
+    gen))."""
+    key = (gen_fn.__name__, tuple(tuple(p) for p in prompts))
+    if key not in _BASELINES:
+        rm = RequestManager(fresh_im(), gen_fn())
+        _BASELINES[key] = rm.generate(prompts)
+    return _BASELINES[key]
+
+
+def kill_spy(fleet):
+    """Wrap kill_replica to capture the victim's in-flight statuses at
+    the moment of death (what 'mid-decode' / 'during prefill' pin)."""
+    seen = {}
+    orig = fleet.kill_replica
+
+    def spy(name, reason="operator kill"):
+        rep = fleet._by_name(name)
+        seen["statuses"] = [(r.rid, r.status, len(r.generated))
+                            for r in rep.rm._active()]
+        seen["admission_closed"] = rep.rm.admission_closed
+        return orig(name, reason)
+
+    fleet.kill_replica = spy
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# routing: fleet == single replica, spread placement
+# ---------------------------------------------------------------------------
+def test_fleet_matches_single_replica_and_spreads_load():
+    want = baseline(greedy, PROMPTS)
+    fleet = FleetRouter([fresh_im() for _ in range(3)], gen=greedy())
+    got = fleet.generate(PROMPTS)
+    assert got == want
+    # least-load dispatch spread the three requests over the fleet
+    assert len(set(fleet.placement.values())) == 3
+    snap = fleet.fleet_snapshot()
+    assert snap["healthy"] == 3 and snap["alive"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the replica-death matrix (ISSUE 14 acceptance)
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+@pytest.mark.parametrize("gen_fn", [greedy, seeded],
+                         ids=["greedy", "seeded"])
+def test_replica_death_mid_decode_bit_identical(gen_fn):
+    want = baseline(gen_fn, PROMPTS)
+    fleet = FleetRouter([fresh_im() for _ in range(2)], gen=gen_fn())
+    for rep in fleet.replicas:
+        rep.rm.scan_chunk = 2  # keep ticks small: the kill lands mid-decode
+    seen = kill_spy(fleet)
+    fleet.schedule_kill("replica0", at_tick=3)
+    got = fleet.generate(PROMPTS)
+    assert got == want, "failover diverged from the fault-free run"
+    # the kill really was mid-decode: the victim held DECODING requests
+    # with committed tokens, and they failed over under their rids
+    assert any(st is RequestStatus.DECODING and n > 0
+               for _, st, n in seen["statuses"])
+    dead = fleet._by_name("replica0")
+    assert dead.state is ReplicaState.DEAD
+    assert dead.leaked == [], "dead replica leaked KV attribution"
+    assert dead.rm.im.state is None, "dead replica buffers not dropped"
+    killed_rids = [rid for rid, _, _ in seen["statuses"]]
+    assert killed_rids and all(fleet._failover_counts.get(rid, 0) >= 1
+                               for rid in killed_rids)
+    # every failed-over rid finished on a SURVIVOR under the same rid
+    for rid in killed_rids:
+        assert fleet.placement[rid] != "replica0"
+        assert fleet.requests[rid].status is RequestStatus.COMPLETED
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("gen_fn", [greedy, seeded],
+                         ids=["greedy", "seeded"])
+def test_replica_death_during_prefill_bit_identical(gen_fn):
+    prompts = [LONG_PROMPT] + PROMPTS[1:]
+    want = baseline(gen_fn, prompts)
+    # max_tokens=8 < len(LONG_PROMPT): its prefill spans ticks, so the
+    # tick-2 kill catches it PREFILLING with zero committed tokens
+    fleet = FleetRouter([fresh_im(max_tokens=8) for _ in range(2)],
+                        gen=gen_fn())
+    seen = kill_spy(fleet)
+    fleet.schedule_kill("replica0", at_tick=2)
+    got = fleet.generate(prompts)
+    assert got == want, "mid-prefill failover diverged"
+    assert any(st is RequestStatus.PREFILLING
+               for _, st, _ in seen["statuses"]), \
+        "the kill did not land during prefill"
+    assert fleet._by_name("replica0").leaked == []
+    assert all(r.status is RequestStatus.COMPLETED
+               for r in fleet.requests.values())
+
+
+@pytest.mark.chaos
+@pytest.mark.migration
+@pytest.mark.parametrize("gen_fn", [greedy, seeded],
+                         ids=["greedy", "seeded"])
+def test_replica_death_during_rolling_drain_bit_identical(gen_fn):
+    """Kill the replica that is currently DRAINING for a rolling
+    migration: its requests (already preempted into its pending by the
+    drain, or still running out the grace window) fail over, the rollout
+    drops its slot and continues on the survivor."""
+    want = baseline(gen_fn, PROMPTS)
+    fleet = FleetRouter([fresh_im() for _ in range(2)], gen=gen_fn())
+    for rep in fleet.replicas:
+        rep.rm.scan_chunk = 2
+    fleet.request_rolling_migration(
+        "tp1_pp1_m1_paged", lambda cand: fresh_im(kv_page_size=16),
+        migration_config=MigrationConfig(auto=False, defer_ticks=1,
+                                         drain_grace_ticks=3))
+    seen = kill_spy(fleet)
+    fleet.schedule_kill("replica0", at_tick=3)  # inside the drain window
+    got = fleet.generate(PROMPTS)
+    assert got == want, "death during a rolling drain diverged"
+    assert seen["admission_closed"], "the kill did not land mid-drain"
+    assert fleet._by_name("replica0").state is ReplicaState.DEAD
+    assert fleet._by_name("replica0").leaked == []
+    # the rollout finished on the survivor (now paged); the dead
+    # replica's slot is recorded, not retried
+    assert fleet._rolling is None
+    done = [h for h in fleet.history
+            if h["event"] == "rolling_migration_completed"]
+    assert len(done) == 1
+    outcomes = {r["replica"]: r["outcome"] for r in done[0]["replicas"]}
+    assert outcomes["replica0"] == "died_mid_migration"
+    assert outcomes["replica1"] == "completed"
+    assert fleet._by_name("replica1").rm.im.kv.paged
+
+
+# ---------------------------------------------------------------------------
+# health state machine: degrade -> quarantine -> re-probe -> readmit / dead
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_quarantine_reprobe_readmit():
+    inj = FaultInjector(seed=0,
+                        p_by_site={"fleet_dispatch:replica1": 1.0},
+                        max_faults=3)
+    fleet = FleetRouter(
+        [fresh_im() for _ in range(2)], gen=greedy(), fault_injector=inj,
+        config=FleetConfig(degraded_after=1, quarantine_after=3,
+                           probe_every=2))
+    got = fleet.generate(PROMPTS)
+    assert got == baseline(greedy, PROMPTS)
+    rep1 = fleet._by_name("replica1")
+    # 3 consecutive fleet_dispatch faults walked HEALTHY -> DEGRADED ->
+    # QUARANTINED; the injector's budget then ran dry, so the first
+    # re-probe succeeded and readmitted it
+    events = [h["event"] for h in fleet.history]
+    assert "replica_quarantined" in events
+    assert "replica_readmitted" in events
+    assert rep1.state is ReplicaState.HEALTHY
+    assert all(r.status is RequestStatus.COMPLETED
+               for r in fleet.requests.values())
+
+
+@pytest.mark.chaos
+def test_probe_exhaustion_marks_dead_and_tears_down():
+    inj = FaultInjector(seed=0,
+                        p_by_site={"fleet_dispatch:replica1": 1.0,
+                                   "fleet_health:replica1": 1.0},
+                        max_faults=16)
+    fleet = FleetRouter(
+        [fresh_im() for _ in range(2)], gen=greedy(), fault_injector=inj,
+        config=FleetConfig(degraded_after=1, quarantine_after=2,
+                           probe_every=1, dead_after_probes=2))
+    got = fleet.generate(PROMPTS)
+    assert got == baseline(greedy, PROMPTS)
+    rep1 = fleet._by_name("replica1")
+    assert rep1.state is ReplicaState.DEAD
+    assert rep1.leaked == []
+    assert rep1.rm.im.state is None
+    assert all(r.status is RequestStatus.COMPLETED
+               for r in fleet.requests.values())
+
+
+# ---------------------------------------------------------------------------
+# retry exhaustion -> failover (the on_exhausted hook), not FAILED
+# ---------------------------------------------------------------------------
+def test_on_exhausted_hook_defaults_off():
+    # the single-replica contract: no hook, exhaustion keeps the r9
+    # requeue-or-FAIL behavior (pinned end-to-end by test_resilience)
+    assert RequestManager.on_exhausted is None
+
+
+@pytest.mark.chaos
+def test_exhaustion_converts_to_failover_not_failed():
+    inj = FaultInjector(seed=0, p_by_site={"step": 1.0}, max_faults=1)
+    res = ResilienceConfig(retry=RetryPolicy(max_retries=0, backoff_s=0.0))
+    fleet = FleetRouter([fresh_im() for _ in range(2)], gen=greedy(),
+                        fault_injector=inj, resilience=res)
+    got = fleet.generate(PROMPTS)
+    assert got == baseline(greedy, PROMPTS)
+    # the exhausted dispatch failed over its batch instead of failing it
+    assert sum(fleet._failover_counts.values()) >= 1
+    assert all(r.status is RequestStatus.COMPLETED
+               for r in fleet.requests.values())
+    assert not any(r.outcome == "failed" for r in fleet.requests.values())
+    # and the exhaustion counted against the replica's health streak
+    assert any(rep.state is ReplicaState.DEGRADED
+               for rep in fleet.replicas)
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation under fleet shrink: REJECTED, never FAILED
+# ---------------------------------------------------------------------------
+def test_admission_regates_against_surviving_capacity():
+    res = ResilienceConfig(kv_gate=True, kv_headroom_frac=0.5)
+    fleet = FleetRouter([fresh_im() for _ in range(2)], gen=greedy(),
+                        resilience=res)
+    # budget pre-shrink: 0.5 * 2 * (2 slots x 64) = 128 positions
+    rids = [fleet.register(p, 8) for p in PROMPTS]  # ~13+11+11 committed
+    assert all(fleet.requests[r].status is not RequestStatus.REJECTED
+               for r in rids)
+    fleet.kill_replica("replica0", reason="shrink")
+    # post-shrink budget halves to 64: the same arrival stream now sheds
+    r4 = fleet.register([1, 2, 3, 4], 8)       # 35 + 12 committed -> ok
+    r5 = fleet.register([1, 2, 3, 4, 5], 8)    # 47 + 13 -> ok
+    r6 = fleet.register([1, 2, 3], 8)          # 60 + 11 > 64 -> shed
+    assert fleet.requests[r4].status is not RequestStatus.REJECTED
+    assert fleet.requests[r5].status is not RequestStatus.REJECTED
+    assert fleet.requests[r6].status is RequestStatus.REJECTED
+    assert fleet.requests[r6].outcome == "rejected"
+    out = fleet.serve_all()
+    # everything admitted still completes on the survivor; nothing FAILED
+    statuses = {r.status for r in fleet.requests.values()}
+    assert RequestStatus.FAILED not in statuses
+    assert all(fleet.requests[r].status is RequestStatus.COMPLETED
+               for r in rids + [r4, r5])
+    assert out[rids[0]] == baseline(greedy, PROMPTS)[0]
+
+
+def test_request_no_survivor_can_hold_is_rejected():
+    big, small = fresh_im(max_seq=64), fresh_im(max_seq=32)
+    fleet = FleetRouter([big, small], gen=greedy())
+    long_prompt = list(range(1, 41))  # needs 48 slots: only the big one
+    rid = fleet.register(long_prompt, 8)
+    fleet._dispatch_queue()  # placement happens at tick boundaries
+    assert fleet.placement.get(rid) == "replica0"
+    fleet.kill_replica("replica0", reason="shrink")
+    # the failover found no survivor that can hold it: explicit REJECTED
+    req = fleet.requests[rid]
+    assert req.status is RequestStatus.REJECTED
+    assert req.outcome == "rejected"
+    # and registering the same shape now raises (or rejects) upfront
+    with pytest.raises(ValueError):
+        fleet.register(long_prompt, 8)
+    rid2 = fleet.register(long_prompt, 8, reject_invalid=True)
+    assert fleet.requests[rid2].status is RequestStatus.REJECTED
+
+
+# ---------------------------------------------------------------------------
+# rolling migration: one replica at a time, >=1 serving at all times
+# ---------------------------------------------------------------------------
+@pytest.mark.migration
+def test_rolling_migration_never_stops_serving():
+    want = baseline(greedy, PROMPTS)
+    fleet = FleetRouter([fresh_im() for _ in range(3)], gen=greedy())
+    for rep in fleet.replicas:
+        rep.rm.scan_chunk = 2
+    serving_floor = []
+    orig_tick = fleet._fleet_tick
+
+    def spy_tick():
+        orig_tick()
+        serving_floor.append(fleet.replicas_serving())
+
+    fleet._fleet_tick = spy_tick
+    fleet.request_rolling_migration(
+        "tp1_pp1_m1_paged", lambda cand: fresh_im(kv_page_size=16))
+    got = fleet.generate(PROMPTS)
+    assert got == want, "tokens diverged across the rolling migration"
+    assert fleet._rolling is None
+    done = [h for h in fleet.history
+            if h["event"] == "rolling_migration_completed"]
+    assert len(done) == 1
+    assert all(r["outcome"] == "completed" for r in done[0]["replicas"])
+    # every replica now runs the paged candidate...
+    assert all(rep.rm.im.kv.paged for rep in fleet.replicas)
+    # ...and at no tick was more than ONE replica out of the rotation
+    assert serving_floor and min(serving_floor) >= 2
+
+
+# ---------------------------------------------------------------------------
+# arrivals + telemetry: records, per-replica summary, schema round-trip
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_fleet_arrivals_records_and_schema(tmp_path):
+    tel = Telemetry(clock=VirtualClock(0.001))
+    fleet = FleetRouter([fresh_im() for _ in range(3)],
+                        gen=greedy(max_new=6), telemetry=tel)
+    fleet.schedule_kill("replica0", at_tick=4)
+    arrivals = [(0.002 * i, PROMPTS[i % 3], 6) for i in range(6)]
+    recs = fleet.serve_with_arrivals(arrivals, clock=VirtualClock(0.001))
+    assert len(recs) == 6
+    for rec in recs.values():
+        assert rec["outcome"] == "ok"
+        # requests that finished before the kill keep their replica0
+        # stamp; everything served after it landed on a survivor
+        assert rec["replica"] in ("replica0", "replica1", "replica2")
+        assert "queue_wait_s" in rec and "prefill_s" in rec
+    late = [r for r in recs.values() if r.get("failovers", 0)]
+    assert all(r["replica"] != "replica0" for r in late)
+    summ = under_load_summary(recs)
+    assert summ["outcomes"] == {"ok": 6}
+    assert set(summ["per_replica"]) <= {"replica0", "replica1", "replica2"}
+    assert sum(s["requests"] for s in summ["per_replica"].values()) == 6
+    assert summ["failovers"] == sum(r["failovers"] for r in recs.values())
+    # the export carries the fleet vocabulary and validates clean
+    paths = tel.export(str(tmp_path), prefix="fleet")
+    assert validate_jsonl(paths["jsonl"]) == []
+    from flexflow_tpu.obs.report import summarize_jsonl
+
+    report = summarize_jsonl(paths["jsonl"])
+    assert report["fleet"]["counters"]["replica_deaths"] == 1
+    assert len(report["fleet"]["replica_events"]["dead"]) == 1
+    assert report["fleet"]["counters"]["fleet_replicas_alive"] == 2.0
+
+
+@pytest.mark.chaos
+def test_all_quarantined_holds_queue_until_readmit():
+    """A transient ALL-QUARANTINED fleet must not shed already-admitted
+    requests: quarantine is recoverable (probes are scheduled), so the
+    queue holds until a replica readmits — only an all-DEAD fleet sheds
+    with REJECTED."""
+    inj = FaultInjector(seed=0, p_by_site={"fleet_dispatch": 1.0},
+                        max_faults=4)
+    fleet = FleetRouter(
+        [fresh_im() for _ in range(2)], gen=greedy(), fault_injector=inj,
+        config=FleetConfig(degraded_after=1, quarantine_after=2,
+                           probe_every=2))
+    got = fleet.generate(PROMPTS)
+    # both replicas quarantined (2 faults each), then the injector ran
+    # dry, probes succeeded, and the held queue served to completion
+    assert got == baseline(greedy, PROMPTS)
+    events = [h["event"] for h in fleet.history]
+    assert events.count("replica_quarantined") == 2
+    assert events.count("replica_readmitted") == 2
+    assert all(r.status is RequestStatus.COMPLETED
+               for r in fleet.requests.values())
+
+
+def test_fleet_cancel_and_ttl_reach_terminal():
+    """Lifecycle composes with the fleet layer: a cancel lands whether
+    the request is still fleet-queued or already replica-held, and a
+    TTL armed on the fleet clock fires on the owning replica."""
+    fleet = FleetRouter([fresh_im()], gen=greedy(max_new=16),
+                        clock=VirtualClock(0.001))
+    fleet.replicas[0].rm.scan_chunk = 2  # ticks small enough to reap
+    r0 = fleet.register(PROMPTS[0], 16)
+    r1 = fleet.register(PROMPTS[1], 16, ttl_s=0.01)
+    r2 = fleet.register(PROMPTS[2], 16)
+    assert fleet.cancel(r2)
+    fleet.serve_all()
+    assert fleet.requests[r0].outcome == "ok"
+    assert fleet.requests[r1].outcome == "timeout"
+    assert fleet.requests[r2].outcome == "cancelled"
+    assert not fleet.has_work()
+    # nothing leaked on any path
+    assert fleet.replicas[0].rm.im.kv.attributed_rids() == []
+
+
+def test_fleet_telemetry_off_is_bit_identical():
+    want = baseline(greedy, PROMPTS)
+    tel = Telemetry(clock=VirtualClock(0.001))
+    fleet = FleetRouter([fresh_im() for _ in range(2)], gen=greedy(),
+                        telemetry=tel)
+    fleet.schedule_kill("replica1", at_tick=3)
+    assert fleet.generate(PROMPTS) == want

@@ -331,7 +331,7 @@ def test_every_emitted_typed_event_is_in_event_schema():
     for path in sources:
         with open(path) as f:
             for name, cat in pat.findall(f.read()):
-                if cat in ("request", "dispatch", "plan"):
+                if cat in ("request", "dispatch", "plan", "fleet"):
                     emitted.add((name, cat))
     assert emitted, "grep found no typed emitters — the pattern broke"
     unknown = {(n, c) for n, c in emitted
@@ -340,3 +340,6 @@ def test_every_emitted_typed_event_is_in_event_schema():
         f"typed events emitted but missing from EVENT_SCHEMA: {unknown}")
     # and the vocabulary this PR added is actually reachable
     assert ("memory_pressure", "plan") in emitted
+    # fleet serving (serve/fleet.py): the replica health vocabulary
+    assert ("replica_dead", "fleet") in emitted
+    assert ("request_failed_over", "request") in emitted

@@ -288,6 +288,46 @@ def test_dry_run_live_migration_roundtrips(dryrun):
     assert reported == s, "trace_report.py diverged on migration events"
 
 
+def test_dry_run_fleet_serving_roundtrips(dryrun):
+    """ISSUE 14 acceptance: the hermetic fleet_serving section kills one
+    of three replicas MID-DECODE — every request terminal, failed-over
+    token streams bit-identical to the fault-free fleet run, the dead
+    replica refcount-clean — and the goodput delta, the fleet event
+    vocabulary, and the per-replica under-load breakdown all ride the
+    real schema and reproduce through the CLI."""
+    _, doc = dryrun
+    fs = doc["observability"]["fleet_serving"]
+    assert fs["bit_identical"], "failover diverged from the fault-free run"
+    assert fs["all_terminal"]
+    assert fs["outcomes"].get("ok") == fs["requests"]
+    assert fs["failovers"] >= 1 and fs["failovers_total"] >= 1
+    assert fs["replica_deaths"] == 1
+    assert fs["kv_leak_free"]
+    # losing a third of the fleet costs goodput, but bounded (the
+    # survivors absorb the failed-over work)
+    g = fs["goodput"]
+    assert g["fault_free_tok_s"] > 0 and g["replica_killed_tok_s"] > 0
+    assert g["delta_frac"] is not None and g["delta_frac"] <= 0
+
+    s = fs["summary"]
+    assert len(s["fleet"]["replica_events"]["dead"]) == 1
+    assert len(s["fleet"]["failed_over"]) == fs["failovers_total"]
+    assert s["fleet"]["counters"]["replica_deaths"] == 1
+    assert s["fleet"]["counters"]["failovers_total"] == \
+        fs["failovers_total"]
+    # per-replica + fleet-aggregate under-load views
+    ul = fs["under_load"]["replica_killed"]
+    assert "per_replica" in ul
+    assert sum(v["requests"] for v in ul["per_replica"].values()) \
+        == fs["requests"]
+
+    # the CLI reproduces the summary from the JSONL alone
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         fs["paths"]["jsonl"]]))
+    assert reported == s, "trace_report.py diverged on fleet events"
+
+
 def test_dry_run_step_profile_reconciles_per_component(dryrun):
     """ISSUE 13 acceptance: a machine model skewed on ONE component (hop
     time x2.5) yields a component-level ``suggested_scale`` that corrects
@@ -374,7 +414,8 @@ def test_check_mode_validates_dry_run_schema(dryrun):
                   doc["observability"]["shared_prefix"]["paths"]["jsonl"],
                   doc["observability"]["spec_serving"]["paths"]["jsonl"],
                   doc["observability"]["live_migration"]["paths"]["jsonl"],
-                  doc["observability"]["step_profile"]["paths"]["jsonl"]):
+                  doc["observability"]["step_profile"]["paths"]["jsonl"],
+                  doc["observability"]["fleet_serving"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
