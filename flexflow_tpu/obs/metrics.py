@@ -84,6 +84,18 @@ class Histogram:
     def percentile(self, q: float) -> Optional[float]:
         return percentile(sorted(self._window), q)
 
+    def tail(self, since_count: int) -> List[float]:
+        """Observations that arrived AFTER lifetime count ``since_count``
+        (clipped to the sliding window).  Lets a consumer that polls on
+        its own cadence — e.g. the brownout controller's per-window SLO
+        attainment (serve/slo.py) — evaluate only FRESH evidence: a
+        single old breach must not pin a recovering signal forever."""
+        fresh = self.count - max(int(since_count), 0)
+        if fresh <= 0:
+            return []
+        fresh = min(fresh, len(self._window))
+        return list(self._window)[len(self._window) - fresh:]
+
     def snapshot(self) -> Dict:
         xs = sorted(self._window)
         return {

@@ -111,7 +111,7 @@ class PlanHealthMonitor:
     def __init__(self, telemetry, plan: Dict, reference=None,
                  config: Optional[PlanHealthConfig] = None,
                  search_fn: Optional[Callable[[], Dict]] = None,
-                 kv_allocator=None):
+                 kv_allocator=None, slo=None, brownout=None):
         # None degrades to the no-op handle: checks still run (drift
         # against an empty window, latencies unavailable), nothing emits
         self.telemetry = telemetry_or_null(telemetry)
@@ -120,6 +120,16 @@ class PlanHealthMonitor:
         self._reset_reference(reference)
         self.search_fn = search_fn
         self.kv_allocator = kv_allocator
+        # SLO-class lanes (serve/slo.py): with an attached SLOPolicy the
+        # monitor ALSO checks each class's own p95s against the class's
+        # targets — a breach on a NON-degradable (latency-critical)
+        # class joins the replan reasons, while a degradable-class
+        # breach escalates an attached BrownoutController FIRST
+        # (degrading batch work is cheaper than a plan switch; only a
+        # ladder already at its max level lets the breach recommend
+        # replan).
+        self.slo = slo
+        self.brownout = brownout
         self.checks = 0
         self.recommendation: Optional[Dict] = None
         # the most recent check() report — the fleet router's least-load
@@ -207,6 +217,42 @@ class PlanHealthMonitor:
                 and tpot["p95"] > cfg.slo_tpot_p95_s:
             report["tpot_p95_s"] = round(tpot["p95"], 6)
             reasons.append("slo_tpot")
+
+        # 2b. PER-CLASS SLO targets (serve/slo.py): each class's own
+        # p95s vs the class's targets.  Routing is class-aware — a
+        # latency-critical breach recommends replan; a degradable
+        # (batch) breach escalates the brownout ladder first and only
+        # recommends replan once the ladder is maxed out (degradation
+        # has nothing left to give).
+        if self.slo is not None:
+            from ..serve.slo import MAX_LEVEL
+
+            escalated = []
+            for name, cls in sorted(self.slo.classes.items()):
+                breaches = []
+                for metric, target in (("ttft_s", cls.ttft_p95_s),
+                                       ("tpot_s", cls.tpot_p95_s)):
+                    if target is None:
+                        continue
+                    snap = self._hist(f"{metric}_cls_{name}")
+                    if (snap.get("count") or 0) < cfg.min_requests:
+                        continue
+                    p95 = snap.get("p95")
+                    if p95 is not None and p95 > target:
+                        breaches.append(metric)
+                        report[f"{metric}_cls_{name}_p95_s"] = round(p95, 6)
+                if not breaches:
+                    continue
+                bo = self.brownout
+                if (cls.degradable and bo is not None
+                        and bo.level < MAX_LEVEL):
+                    bo.note_slo_breach(name)
+                    escalated.append(name)
+                else:
+                    for metric in breaches:
+                        reasons.append(f"slo_class_{metric}:{name}")
+            if escalated:
+                report["brownout_escalated"] = escalated
 
         # 3. workload drift vs the planned-for reference
         drift = self.detector.check(

@@ -54,6 +54,10 @@ _MIG_EVENTS = ("migration_started", "migration_completed",
 _FLEET_EVENTS = ("replica_up", "replica_degraded", "replica_quarantined",
                  "replica_dead")
 _FAILOVER = "request_failed_over"
+# SLO-class lanes + brownout (serve/slo.py): ladder transitions and
+# explicit lane sheds
+_BROWNOUT = "brownout_level_changed"
+_LANE_SHED = "lane_shed"
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -83,6 +87,8 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     migrations: Dict[str, List[Dict]] = {n: [] for n in _MIG_EVENTS}
     fleet_events: Dict[str, List[Dict]] = {n: [] for n in _FLEET_EVENTS}
     failovers: List[Dict] = []
+    brownout_changes: List[Dict] = []
+    lane_sheds: List[Dict] = []
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -126,6 +132,12 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _FAILOVER:
             failovers.append(ev.get("args", {}))
+            continue
+        if name == _BROWNOUT:
+            brownout_changes.append(ev.get("args", {}))
+            continue
+        if name == _LANE_SHED:
+            lane_sheds.append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -207,6 +219,12 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
                                for n in _FLEET_EVENTS},
             "failed_over": failovers,
         },
+        # SLO-class lanes + brownout (serve/slo.py): degradation-ladder
+        # transitions and explicit lane sheds
+        "slo": {
+            "brownout_changes": brownout_changes,
+            "lane_shed": lane_sheds,
+        },
     }
 
 
@@ -281,6 +299,17 @@ def summarize_jsonl(path: str) -> Dict:
 
     summary["fleet"]["counters"] = {
         k: metrics[k] for k in FLEET_COUNTERS if k in metrics}
+    # SLO-lane view: the events summarize_events collected + the exact
+    # registry counters (SLO_COUNTERS — deferral/shed/degrade totals and
+    # the ladder's escalation counters; brownout_level is a gauge holding
+    # the final level) and the per-class pending-depth gauges
+    from .telemetry import SLO_COUNTERS
+
+    summary["slo"]["counters"] = {
+        k: metrics[k] for k in SLO_COUNTERS if k in metrics}
+    summary["slo"]["lane_depths"] = {
+        k: metrics[k] for k in sorted(metrics)
+        if k.startswith("lane_pending_depth_")}
 
     pred_err: Dict[str, Dict] = {}
     for plan, fields in calibration.get("plans", {}).items():
@@ -468,7 +497,7 @@ def validate_jsonl(path: str) -> List[str]:
         # typed vocabulary: the categories the report parses semantically
         cat = doc.get("cat")
         if ph == "i" and cat in ("request", "dispatch", "plan", "profile",
-                                 "fleet"):
+                                 "fleet", "slo"):
             name = doc["name"]
             schema = EVENT_SCHEMA.get(name)
             if schema is None:
@@ -487,7 +516,8 @@ def validate_jsonl(path: str) -> List[str]:
 
 
 def under_load_summary(records: Dict, makespan_s: Optional[float] = None,
-                       per_replica: bool = True) -> Dict:
+                       per_replica: bool = True,
+                       per_class: bool = True) -> Dict:
     """Reduce ``RequestManager.serve_with_arrivals`` records to the
     ``serving_under_load`` fields: TTFT distribution (split into queue wait
     vs prefill where the records carry the split), per-request TPOT
@@ -499,7 +529,15 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None,
     per-request ``failovers``) additionally get a ``per_replica``
     breakdown — the same reduction per serving replica, sharing the
     fleet-wide makespan so per-replica goodputs SUM to the fleet
-    aggregate — and a total ``failovers`` count."""
+    aggregate — and a total ``failovers`` count.
+
+    SLO-lane records (``slo_class`` stamped when an
+    :class:`~flexflow_tpu.serve.slo.SLOPolicy` was attached) get the
+    same-shaped ``per_class`` breakdown — per-class goodput / TTFT /
+    TPOT p50/p95 / outcome mix on the shared makespan, the view the
+    per-class SLO attainment claims are checked against — plus a
+    ``deferred_requests`` count (requests that spent at least one
+    brownout window queue-held)."""
     recs = list(records.values())
     outcomes: Dict[str, int] = {}
     for r in recs:
@@ -543,9 +581,23 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None,
             groups.setdefault(r.get("replica", ""), {})[rid] = r
         replica_summary = {
             name: under_load_summary(group, makespan_s=makespan,
-                                     per_replica=False)
+                                     per_replica=False, per_class=False)
             for name, group in sorted(groups.items())}
         failover_total = sum(r.get("failovers", 0) for r in recs)
+    # SLO-lane breakdown: group by the stamped class (records without a
+    # class — no policy attached when they registered — group under "")
+    class_summary = None
+    deferred_total = None
+    if per_class and any("slo_class" in r for r in recs):
+        cgroups: Dict[str, Dict] = {}
+        for rid, r in records.items():
+            cgroups.setdefault(r.get("slo_class", ""), {})[rid] = r
+        class_summary = {
+            name: under_load_summary(group, makespan_s=makespan,
+                                     per_replica=False, per_class=False)
+            for name, group in sorted(cgroups.items())}
+        deferred_total = sum(1 for r in recs
+                             if r.get("deferred_ticks", 0) > 0)
     return {
         "requests": len(recs),
         "completed": len(done),
@@ -565,4 +617,8 @@ def under_load_summary(records: Dict, makespan_s: Optional[float] = None,
            if replica_summary is not None else {}),
         **({"failovers": failover_total}
            if failover_total is not None else {}),
+        **({"per_class": class_summary}
+           if class_summary is not None else {}),
+        **({"deferred_requests": deferred_total}
+           if deferred_total is not None else {}),
     }

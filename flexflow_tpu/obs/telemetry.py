@@ -110,6 +110,12 @@ EVENT_SCHEMA = {
     "replica_dead": ("fleet", ("replica",)),
     "request_failed_over": ("request", ("trace_id", "from_replica",
                                         "to_replica")),
+    # SLO-class lanes + brownout (serve/slo.py): the BrownoutController
+    # walked the degradation ladder one level (args carry both endpoints
+    # + the pressure reason), and one degradable-class request was shed
+    # by the ladder (explicit REJECTED — never FAILED)
+    "brownout_level_changed": ("slo", ("level", "from_level")),
+    "lane_shed": ("slo", ("slo_class",)),
 }
 
 # migration counter/gauge vocabulary (report.py folds these into the
@@ -145,6 +151,26 @@ FLEET_COUNTERS = (
 FLEET_REGRESSION_COUNTERS = (
     "failovers_total", "replica_degradations", "replica_quarantines",
     "replica_deaths",
+)
+
+# SLO-lane / brownout counter vocabulary (serve/slo.py; report.py folds
+# these into the ``slo`` summary section — one tuple shared by the
+# emitters, the report, and the bench dry-run).  All are exact cumulative
+# counters except ``brownout_level``, a gauge holding the ladder's
+# current level.
+SLO_COUNTERS = (
+    "lane_deferred_total", "lane_shed_total", "lane_degraded_total",
+    "brownout_escalations", "brownout_deescalations", "brownout_level",
+)
+
+# the monotone bad-if-increasing subset that joins bench_compare's exact
+# class (deterministic on the seeded virtual clock): more shed /
+# deferred requests or more ladder escalations for the same workload
+# means the lanes got less graceful.  De-escalations and the level gauge
+# stay out (non-monotone direction).
+SLO_REGRESSION_COUNTERS = (
+    "lane_shed_total", "lane_deferred_total", "lane_degraded_total",
+    "brownout_escalations",
 )
 
 
@@ -215,22 +241,32 @@ class Telemetry:
                                   "requests", trace_id=trace_id)
 
     def request_first_token(self, trace_id: str,
-                            ttft_s: Optional[float] = None) -> float:
+                            ttft_s: Optional[float] = None,
+                            slo_class: Optional[str] = None) -> float:
         if ttft_s is not None:
             self.metrics.histogram("ttft_s").observe(ttft_s)
+            if slo_class:
+                # per-class attainment: the brownout controller and the
+                # plan-health per-class checks read these windows
+                self.metrics.histogram(
+                    f"ttft_s_cls_{slo_class}").observe(ttft_s)
         return self.trace.instant("request_first_token", "request",
                                   "requests", trace_id=trace_id,
                                   ttft_s=ttft_s)
 
     def request_finished(self, trace_id: str, n_tokens: int,
                          tpot_s: Optional[float] = None,
-                         kv_bytes: Optional[float] = None) -> float:
+                         kv_bytes: Optional[float] = None,
+                         slo_class: Optional[str] = None) -> float:
         """``kv_bytes``: the KVAllocator's per-request attribution (peak
         cache bytes the request held) — the byte-side cost of serving it."""
         self.metrics.counter("requests_finished").inc()
         self.metrics.counter("tokens_generated").inc(n_tokens)
         if tpot_s is not None:
             self.metrics.histogram("tpot_s").observe(tpot_s)
+            if slo_class:
+                self.metrics.histogram(
+                    f"tpot_s_cls_{slo_class}").observe(tpot_s)
         if kv_bytes is not None:
             self.metrics.histogram("request_kv_bytes").observe(kv_bytes)
         self.workload.observe_finish(n_tokens)
@@ -424,6 +460,49 @@ class Telemetry:
         m.gauge("fleet_queue_depth").set(queue_depth)
         self.trace.counter("fleet_replicas_healthy", healthy)
         self.trace.counter("fleet_queue_depth", queue_depth)
+
+    # ---- SLO-class lanes + brownout (serve/slo.py) ---------------------
+    def brownout_level_changed(self, level: int, from_level: int,
+                               level_name: str = "",
+                               reason: str = "") -> float:
+        """The BrownoutController stepped the degradation ladder one
+        level (up on ``escalate_after`` pressured windows, down on
+        ``deescalate_after`` clean ones — the hysteresis contract)."""
+        m = self.metrics
+        if level > from_level:
+            m.counter("brownout_escalations").inc()
+        else:
+            m.counter("brownout_deescalations").inc()
+        m.gauge("brownout_level").set(level)
+        return self.trace.instant("brownout_level_changed", "slo", "slo",
+                                  level=level, from_level=from_level,
+                                  level_name=level_name, reason=reason)
+
+    def lane_shed(self, slo_class: str, trace_id: str = "",
+                  reason: str = "") -> float:
+        """The ladder shed one degradable-class request (queued or — at
+        CRITICAL_ONLY — live) as an explicit ``REJECTED``."""
+        self.metrics.counter("lane_shed_total").inc()
+        return self.trace.instant("lane_shed", "slo", "slo",
+                                  slo_class=slo_class, trace_id=trace_id,
+                                  reason=reason)
+
+    def lane_deferred(self, slo_class: str, count: int = 1) -> None:
+        """``count`` queued requests of a degradable class were held out
+        of engine slots this brownout window (DEFER_BATCH semantics)."""
+        self.metrics.counter("lane_deferred_total").inc(count)
+
+    def lane_degraded(self, slo_class: str, count: int = 1) -> None:
+        """``count`` live requests had speculation flipped off and/or
+        their output capped (DEGRADE_BATCH semantics)."""
+        self.metrics.counter("lane_degraded_total").inc(count)
+
+    def lane_depths(self, depths: Dict[str, int]) -> None:
+        """Per-class pending-queue depth gauges, published each brownout
+        evaluation window (``lane_pending_depth_<class>``)."""
+        for name, depth in depths.items():
+            self.metrics.gauge(f"lane_pending_depth_{name}").set(depth)
+            self.trace.counter(f"lane_pending_depth_{name}", depth)
 
     def spec_batch_mix(self, spec_requests: int, plain_requests: int) -> None:
         """One mixed verify macro-step's request composition: how many
@@ -651,6 +730,21 @@ class NullTelemetry:
         return 0.0
 
     def fleet_health(self, *a, **k):
+        return None
+
+    def brownout_level_changed(self, *a, **k):
+        return 0.0
+
+    def lane_shed(self, *a, **k):
+        return 0.0
+
+    def lane_deferred(self, *a, **k):
+        return None
+
+    def lane_degraded(self, *a, **k):
+        return None
+
+    def lane_depths(self, *a, **k):
         return None
 
     def spec_batch_mix(self, *a, **k):

@@ -52,6 +52,13 @@ from .migration import (
     build_deployment,
 )
 from .fleet import FleetConfig, FleetRouter, ReplicaState
+from .slo import (
+    BrownoutConfig,
+    BrownoutController,
+    BrownoutLevel,
+    SLOClass,
+    SLOPolicy,
+)
 from .spec_infer import SpecInferManager
 from .api import LLM, SSM
 from .weights import convert_state_dict, load_hf_model, place_params
@@ -89,6 +96,11 @@ __all__ = [
     "FleetRouter",
     "FleetConfig",
     "ReplicaState",
+    "SLOClass",
+    "SLOPolicy",
+    "BrownoutLevel",
+    "BrownoutConfig",
+    "BrownoutController",
     "LLM",
     "SSM",
     "convert_state_dict",

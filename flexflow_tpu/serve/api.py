@@ -72,6 +72,8 @@ class LLM:
         fault_injector=None,
         plan_health=None,
         profiler=None,
+        slo=None,
+        brownout=None,
     ) -> "LLM":
         """``kv_dtype="int8"`` stores the KV caches int8 with fused
         in-kernel dequant (see ``InferenceManager``) — halves decode KV
@@ -94,7 +96,14 @@ class LLM:
         :meth:`health`).  ``profiler`` attaches a
         :class:`~flexflow_tpu.obs.StepProfiler` (step-level cost
         attribution: per-phase time budgets + deterministic work
-        counters; bit-identical outputs with it on or off)."""
+        counters; bit-identical outputs with it on or off).
+        ``slo`` attaches an :class:`~flexflow_tpu.serve.slo.SLOPolicy`
+        (per-request ``slo_class`` lanes: priority bands, per-class
+        bounded queues and TTFT/TPOT targets, reserved KV headroom);
+        ``brownout`` a :class:`~flexflow_tpu.serve.slo.
+        BrownoutController` walking the graceful-degradation ladder
+        under overload (defer -> degrade -> shed batch-class work, with
+        hysteresis) — see ``serve/slo.py``."""
         devices = devices if devices is not None else jax.devices()[:tp]
         mesh = make_mesh({"tp": tp}, devices)
         ff = FFModel(FFConfig(), mesh=mesh)
@@ -140,14 +149,15 @@ class LLM:
                 self.im, ssm.im, gen, width=spec_width, depth=spec_depth,
                 telemetry=telemetry, resilience=resilience,
                 fault_injector=fault_injector, plan_health=plan_health,
-                profiler=profiler,
+                profiler=profiler, slo=slo, brownout=brownout,
             )
         else:
             self.rm = RequestManager(self.im, gen, telemetry=telemetry,
                                      resilience=resilience,
                                      fault_injector=fault_injector,
                                      plan_health=plan_health,
-                                     profiler=profiler)
+                                     profiler=profiler, slo=slo,
+                                     brownout=brownout)
         return self
 
     def health(self):

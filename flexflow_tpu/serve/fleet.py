@@ -115,6 +115,14 @@ class FleetConfig:
       before it goes terminally FAILED — the bound that keeps a request
       from looping forever across a fleet whose every replica keeps
       failing (the fleet-level analog of r9's ``max_requeues``).
+    * ``starvation_bound_ticks``: bounded aging for the dispatch queue's
+      stable priority sort — a request queued longer than this many
+      fleet ticks becomes OVERDUE and sorts ahead of every priority
+      band (FIFO among overdue), so a lower-priority class held behind a
+      sustained higher-priority stream is starved only up to this bound
+      (pinned by the starvation test).  None disables aging.  A
+      brownout DEFER hold is exempt: that is an explicit policy state
+      with its own hysteresis-bounded exit, not priority competition.
     """
 
     degraded_after: int = 1
@@ -123,6 +131,7 @@ class FleetConfig:
     dead_after_probes: int = 2
     degraded_penalty: float = 1000.0
     max_failovers_per_request: int = 8
+    starvation_bound_ticks: Optional[int] = 256
 
 
 @dataclasses.dataclass
@@ -176,7 +185,8 @@ class FleetRouter:
                  resilience: Optional[ResilienceConfig] = None,
                  fault_injector=None, clock=None, profiler=None,
                  config: Optional[FleetConfig] = None,
-                 names: Optional[Sequence[str]] = None):
+                 names: Optional[Sequence[str]] = None,
+                 slo=None, brownout=None):
         import time as _time
 
         if not replicas:
@@ -188,6 +198,27 @@ class FleetRouter:
         self.clock = clock or _time.perf_counter
         self.profiler = profiler_or_null(profiler)
         self.config = config or FleetConfig()
+        # SLO-class lanes + brownout (serve/slo.py): the FLEET owns the
+        # policy and the one ladder over the whole fleet.  Replicas get
+        # references to both (queue-hold/preemption gates) but the
+        # ladder is EVALUATED only here — never double-driven, see the
+        # replica loop below.  Attaching a policy without a controller
+        # builds one on the fleet's clock/telemetry: configuring lanes
+        # opts into graceful degradation.
+        self.slo = slo
+        if brownout is None and slo is not None:
+            from .slo import BrownoutController
+
+            brownout = BrownoutController(slo, telemetry=telemetry,
+                                          clock=self.clock)
+        self.brownout = brownout
+        if brownout is not None and slo is None:
+            self.slo = brownout.policy
+        # per-class committed-need high-watermarks (same units as the
+        # admission budget) — the observable the reservation contract is
+        # asserted against ("batch never dipped into the lc reservation")
+        self.lane_committed_hwm: Dict[str, float] = {}
+        self._enqueue_tick: Dict[int, int] = {}  # rid -> fleet tick queued
         self.replicas: List[Replica] = []
         for i, dep in enumerate(replicas):
             name = names[i] if names else f"replica{i}"
@@ -201,6 +232,18 @@ class FleetRouter:
                     clock=self.clock,
                     profiler=profiler if self.profiler.enabled else None)
             rm.on_exhausted = self._on_replica_exhausted
+            # replica-level bounded aging: the satellite's starvation
+            # bound applies wherever the priority sort actually queues —
+            # the fleet dispatch queue AND each replica's pending queue
+            rm.starvation_bound_ticks = self.config.starvation_bound_ticks
+            # the lane policy + ladder reach the replica's OWN queue
+            # gates (_pop_pending holds, preemption eligibility) so a
+            # DEFER really holds replica-pending work too; the ladder is
+            # still EVALUATED only by the fleet — RequestManager's
+            # _maybe_brownout runs from its own serve loops, which the
+            # fleet never drives
+            rm.slo = self.slo
+            rm.brownout = self.brownout
             self.replicas.append(Replica(name=name, index=i, rm=rm))
             if self.telemetry.enabled:
                 self.telemetry.replica_up(name, reason="fleet start")
@@ -293,6 +336,9 @@ class FleetRouter:
             if backlog >= res.max_pending:
                 return (f"pending queue full ({backlog} >= "
                         f"{res.max_pending})")
+        reason = self._lane_admission_reason(req)
+        if reason is not None:
+            return reason
         if res.kv_gate:
             cap_tokens = 0
             per_toks = []
@@ -319,10 +365,72 @@ class FleetRouter:
                     return (f"KV headroom: {need * per_tok / 2**20:.2f} "
                             f"MiB committed > "
                             f"{res.kv_budget_bytes / 2**20:.2f} MiB budget")
-            elif need > res.kv_headroom_frac * cap_tokens:
-                return (f"KV headroom: {need} tokens committed > "
-                        f"{res.kv_headroom_frac * cap_tokens:.0f} across "
-                        f"{len(alive)} surviving replicas")
+                budget, price = res.kv_budget_bytes, per_tok
+            else:
+                if need > res.kv_headroom_frac * cap_tokens:
+                    return (f"KV headroom: {need} tokens committed > "
+                            f"{res.kv_headroom_frac * cap_tokens:.0f} "
+                            f"across {len(alive)} surviving replicas")
+                budget, price = res.kv_headroom_frac * cap_tokens, 1.0
+            # reserved-lane gate (serve/slo.py): same fleet-aggregate
+            # budget and worst-case-need arithmetic — each class's
+            # committed charges its own reservation first, only overflow
+            # competes for the shared pool, so batch traffic can never
+            # consume the latency-critical lane's reservation whatever
+            # the arrival order (the hwm tracking in _maybe_brownout is
+            # the observable this contract is asserted against)
+            reason = self._lane_reservation_reason(req, live, budget,
+                                                   price)
+            if reason is not None:
+                return reason
+        return None
+
+    def _lane_reservation_reason(self, req: Request, live, budget: float,
+                                 per_tok: float) -> Optional[str]:
+        slo = self.slo
+        if slo is None or not any(c.kv_reservation_frac
+                                  for c in slo.classes.values()):
+            return None
+        cls = slo.resolve(req.slo_class)
+        if cls is None:
+            return None
+        from .slo import reservation_reason
+
+        by_cls: Dict[str, float] = {}
+        for r in live:
+            rc = slo.resolve(r.slo_class)
+            key = rc.name if rc is not None else r.slo_class
+            by_cls[key] = by_cls.get(key, 0.0) + self._need(r) * per_tok
+        return reservation_reason(slo, by_cls, cls,
+                                  self._need(req) * per_tok, budget)
+
+    def _lane_admission_reason(self, req: Request) -> Optional[str]:
+        """Lane-level fleet admission: the brownout ladder's gate for
+        degradable classes + the per-class bounded pending queue
+        (fleet queue and replica pendings count together — one lane
+        spans the fleet)."""
+        if self.slo is None:
+            return None
+        cls = self.slo.resolve(req.slo_class)
+        if cls is None:
+            return None
+        bo = self.brownout
+        if bo is not None and not bo.admits(cls.name):
+            if self.telemetry.enabled:
+                self.telemetry.lane_shed(cls.name, trace_id=req.trace_id,
+                                         reason=f"brownout:{bo.level.name}")
+            return (f"brownout {bo.level.name}: class {cls.name!r} "
+                    "admissions shed")
+        if cls.max_pending is not None:
+            depth = sum(
+                1 for rid in self.queue
+                if self.requests[rid].slo_class == cls.name)
+            for rep in self._alive():
+                depth += sum(1 for rid in rep.rm.pending
+                             if rep.rm.requests[rid].slo_class == cls.name)
+            if depth >= cls.max_pending:
+                return (f"class {cls.name!r} pending queue full "
+                        f"({depth} >= {cls.max_pending})")
         return None
 
     def register(self, prompt_tokens: Sequence[int],
@@ -331,7 +439,8 @@ class FleetRouter:
                  deadline_s: Optional[float] = None,
                  reject_invalid: bool = False,
                  reject_reason: Optional[str] = None,
-                 spec: Optional[bool] = None) -> int:
+                 spec: Optional[bool] = None,
+                 slo_class: Optional[str] = None) -> int:
         """Register a request with the fleet; returns its rid.
 
         Mirrors :meth:`RequestManager.register_new_request` semantics: a
@@ -341,11 +450,23 @@ class FleetRouter:
         the explicit ``REJECTED`` path; ``max_new_tokens=0`` completes
         immediately.  ``spec`` is the request's speculation preference,
         applied when (and only when) it lands on a spec-capable replica.
+        ``slo_class`` names the request's lane under an attached
+        :class:`~.slo.SLOPolicy` (None/"" = the default class; unknown
+        names reject) — the class's priority band, bounded queue, KV
+        reservation, and brownout gates apply at the FLEET gate.
         """
         req = Request(
             -1, [int(t) for t in prompt_tokens],
             self.gen.max_new_tokens if max_new_tokens is None
             else int(max_new_tokens))
+        band = 0
+        if self.slo is not None:
+            cls = self.slo.resolve(slo_class)
+            if cls is None:
+                req.slo_class = str(slo_class)
+            else:
+                req.slo_class = cls.name
+                band = cls.priority_band
         alive = self._alive()
         err = reject_reason
         if err is None:
@@ -355,13 +476,16 @@ class FleetRouter:
                 errs = [rep.rm._validate_request(req) for rep in alive]
                 if all(e is not None for e in errs):
                     err = errs[0]
+        if err is None and self.slo is not None \
+                and self.slo.resolve(slo_class) is None:
+            err = f"unknown slo_class {slo_class!r}"
         if err is not None and not reject_invalid:
             raise ValueError(err)
         rid = self._next_rid
         self._next_rid += 1
         req.rid = rid
         req.trace_id = f"r{rid:05d}"
-        req.priority = int(priority)
+        req.priority = int(priority) + band
         self.requests[rid] = req
         self._spec_pref[rid] = spec
         tel = self.telemetry
@@ -377,8 +501,22 @@ class FleetRouter:
             req.status = RequestStatus.COMPLETED
             req.outcome = "ok"
             if tel.enabled:
-                tel.request_finished(req.trace_id, n_tokens=0)
+                tel.request_finished(req.trace_id, n_tokens=0,
+                                     slo_class=req.slo_class or None)
             return rid
+        if self.brownout is not None and self.brownout.degrades(
+                req.slo_class):
+            # DEGRADE_BATCH in force: admitted, but speculation off and
+            # the class output cap applied (prefix truncation only).
+            # Counted only on real change (exact-compare counter)
+            changed = bool(self._spec_pref.get(rid))
+            self._spec_pref[rid] = False
+            cap = self.brownout.output_cap(req.slo_class)
+            if cap is not None and cap < req.max_new_tokens:
+                req.max_new_tokens = max(cap, 1)
+                changed = True
+            if changed and tel.enabled:
+                tel.lane_degraded(req.slo_class)
         if deadline_s is not None:
             req.deadline_s = float(deadline_s)
         else:
@@ -387,6 +525,7 @@ class FleetRouter:
                 req.deadline_s = self.clock() + float(ttl)
         self.queue.append(rid)
         self._live.add(rid)
+        self._enqueue_tick[rid] = self.ticks
         return rid
 
     def cancel(self, rid: int) -> bool:
@@ -407,6 +546,7 @@ class FleetRouter:
         if req.rid in self.queue:
             self.queue.remove(req.rid)
         self._live.discard(req.rid)
+        self._enqueue_tick.pop(req.rid, None)
         req.status = status
         req.outcome = OUTCOMES[status]
         req.prefill_src = None
@@ -498,7 +638,8 @@ class FleetRouter:
                                 req.max_new_tokens)
             for f in ("trace_id", "priority", "deadline_s",
                       "cancel_requested", "preemptions", "requeues",
-                      "kv_bytes", "n_prefed", "status"):
+                      "kv_bytes", "n_prefed", "status", "slo_class",
+                      "deferred_ticks"):
                 setattr(nr, f, getattr(req, f))
             nr.generated = list(req.generated)
             nr.prefill_src = (list(req.prefill_src)
@@ -513,9 +654,11 @@ class FleetRouter:
         req.starved_steps = 0
         rm.requests[rid] = req
         rm.pending.append(rid)
+        rm._pending_since[rid] = rm.steps
         rm._next_rid = max(rm._next_rid, self._next_rid)
         rm._tstamps[rid] = self._tstamps.setdefault(rid, {})
         self.placement[rid] = rep.name
+        self._enqueue_tick.pop(rid, None)
         rep.dispatched += 1
         frm = self._failover_from.pop(rid, None)
         if frm is not None and self.telemetry.enabled:
@@ -539,14 +682,39 @@ class FleetRouter:
             # the truly terminal all-DEAD fleet sheds it
             return
         # priority order, FIFO within a class (stable sort — the same
-        # rule RequestManager._pop_pending applies per replica)
-        self.queue.sort(key=lambda rid: -self.requests[rid].priority)
+        # rule RequestManager._pop_pending applies per replica), with
+        # BOUNDED AGING: a request queued past
+        # ``config.starvation_bound_ticks`` becomes OVERDUE and sorts
+        # ahead of every priority band (FIFO among overdue, by enqueue
+        # tick), so a sustained higher-priority stream can starve a
+        # lower class only up to the bound
+        bound = self.config.starvation_bound_ticks
+
+        def overdue(rid: int) -> bool:
+            return (bound is not None
+                    and self.ticks - self._enqueue_tick.get(rid, self.ticks)
+                    >= bound)
+
+        self.queue.sort(key=lambda rid: (
+            (0, self._enqueue_tick.get(rid, 0)) if overdue(rid)
+            else (1, -self.requests[rid].priority)))
         takers = [rep for rep in alive if not rep.rm.admission_closed]
         remaining: List[int] = []
+        bo = self.brownout
         # snapshot: _terminate mutates self.queue (rejection path), and
         # iterating the live list would silently skip the next entry
         for rid in list(self.queue):
             req = self.requests[rid]
+            if bo is not None and bo.holds(req.slo_class):
+                # DEFER_BATCH: held in the fleet queue — an explicit
+                # policy hold with its own hysteresis-bounded exit
+                # (aging does not override it; TTLs still apply).  The
+                # hold time is EXEMPT from aging: re-stamp so the held
+                # backlog does not come out of a long brownout overdue
+                # and jump the latency-critical lane at recovery
+                self._enqueue_tick[rid] = self.ticks
+                remaining.append(rid)
+                continue
             cands = [rep for rep in takers
                      if rep.rm._validate_request(req) is None]
             if not cands:
@@ -585,6 +753,7 @@ class FleetRouter:
                 rm.preempt(rid)
             if rid in rm.pending:
                 rm.pending.remove(rid)
+            rm._pending_since.pop(rid, None)
             rm.requests.pop(rid, None)
             rm._tstamps.pop(rid, None)
             self.requests[rid] = req
@@ -600,6 +769,9 @@ class FleetRouter:
                                 reason=reason)
             else:
                 kept.append(rid)
+                # the wait clock restarts on failover: aging measures
+                # time queued for THIS dispatch
+                self._enqueue_tick[rid] = self.ticks
         self.queue.extend(kept)
         return kept
 
@@ -851,6 +1023,9 @@ class FleetRouter:
     def _adopt_successor(self, rep: Replica, new_rm) -> None:
         rep.rm = new_rm
         new_rm.on_exhausted = self._on_replica_exhausted
+        new_rm.starvation_bound_ticks = self.config.starvation_bound_ticks
+        new_rm.slo = self.slo
+        new_rm.brownout = self.brownout
         # a live migration transplants requests into NEW record objects
         # (rids preserved) — re-point the fleet registry at the live
         # ones, or results/records would freeze at the drain snapshot
@@ -892,16 +1067,143 @@ class FleetRouter:
         if new_rm is not None:
             self._adopt_successor(rep, new_rm)
 
+    def _maybe_brownout(self) -> None:
+        """Evaluate the fleet-level BrownoutController every
+        ``config.check_every`` fleet ticks and apply the ladder's
+        actions across the whole fleet (see serve/slo.py): DEFER holds
+        the fleet queue's degradable classes (``_dispatch_queue``),
+        DEGRADE flips speculation off and caps output for LIVE
+        degradable requests on every replica (the r14 ``set_spec_mode``
+        path), SHED rejects their queued work fleet-wide, CRITICAL_ONLY
+        also evicts their slotted work — every shed is an explicit
+        ``REJECTED``, never ``FAILED``."""
+        bo = self.brownout
+        if bo is None:
+            return
+        if self.ticks % bo.config.check_every:
+            return
+        slo = self.slo
+        tel = self.telemetry
+        alive = self._alive()
+        # signals: latency-critical lane depth (fleet queue + replica
+        # pendings) and fleet-aggregate KV occupancy
+        depths: Dict[str, int] = {c: 0 for c in slo.classes}
+        lc_depth = 0
+        held_queued: List[Request] = []
+
+        def note(req: Request, queued: bool) -> None:
+            nonlocal lc_depth
+            cls = slo.resolve(req.slo_class)
+            if cls is None:
+                return
+            depths[cls.name] = depths.get(cls.name, 0) + 1
+            if not cls.degradable:
+                lc_depth += 1
+            elif queued:
+                held_queued.append(req)
+
+        for rid in self.queue:
+            note(self.requests[rid], queued=True)
+        live_tok = cap_tok = 0
+        committed: Dict[str, float] = {}
+        for rep in alive:
+            for rid in rep.rm.pending:
+                note(rep.rm.requests[rid], queued=True)
+            kv = getattr(rep.rm.im, "kv", None)
+            if kv is not None:
+                live_tok += kv.live_tokens()
+                cap_tok += kv.capacity_tokens
+        # per-class committed-need high-watermark (token units — the
+        # reservation contract's observable): replica-HELD requests
+        # only, the same population the admission gate prices
+        for rid in self._live:
+            req = self.requests[rid]
+            if req.status in TERMINAL_STATUSES or rid in self.queue:
+                continue
+            key = req.slo_class or ""
+            committed[key] = committed.get(key, 0.0) + self._need(req)
+        for key, tot in committed.items():
+            if tot > self.lane_committed_hwm.get(key, 0.0):
+                self.lane_committed_hwm[key] = tot
+        if tel.enabled:
+            tel.lane_depths(depths)
+        bo.evaluate(lc_queue_depth=lc_depth,
+                    kv_occupancy_frac=(live_tok / cap_tok if cap_tok
+                                       else 0.0))
+        if bo.level == 0:
+            return
+        # --- apply the level's actions fleet-wide ----------------------
+        deferred: Dict[str, int] = {}
+        for req in held_queued:
+            if req.status in TERMINAL_STATUSES:
+                continue
+            if bo.sheds_queued(req.slo_class):
+                if tel.enabled:
+                    tel.lane_shed(req.slo_class, trace_id=req.trace_id,
+                                  reason=f"brownout:{bo.level.name}")
+                if req.rid in self.queue:
+                    self._terminate(req, RequestStatus.REJECTED,
+                                    reason="brownout shed")
+                else:
+                    # replica-pending: pull it off, then shed at the fleet
+                    rep = self._by_name(self.placement[req.rid])
+                    rep.rm.pending.remove(req.rid)
+                    rep.rm._pending_since.pop(req.rid, None)
+                    rep.rm.requests.pop(req.rid, None)
+                    rep.rm._tstamps.pop(req.rid, None)
+                    self._live.add(req.rid)
+                    self._terminate(req, RequestStatus.REJECTED,
+                                    reason="brownout shed")
+            elif bo.holds(req.slo_class):
+                req.deferred_ticks += 1
+                deferred[req.slo_class] = deferred.get(req.slo_class, 0) + 1
+        if tel.enabled:
+            for cname, cnt in deferred.items():
+                tel.lane_deferred(cname, count=cnt)
+        if bo.level < 2:  # below DEGRADE_BATCH: nothing touches live work
+            return
+        for rep in alive:
+            rm = rep.rm
+            for req in list(rm._active()):
+                if req.status in TERMINAL_STATUSES:
+                    continue
+                if bo.sheds_live(req.slo_class):
+                    # CRITICAL_ONLY: evict + shed slotted degradable work
+                    rm.preempt(req.rid)
+                    rm.pending.remove(req.rid)
+                    rm._pending_since.pop(req.rid, None)
+                    rm.requests.pop(req.rid, None)
+                    rm._tstamps.pop(req.rid, None)
+                    self.requests[req.rid] = req
+                    self._live.add(req.rid)
+                    if tel.enabled:
+                        tel.lane_shed(req.slo_class, trace_id=req.trace_id,
+                                      reason="brownout:CRITICAL_ONLY")
+                    self._terminate(req, RequestStatus.REJECTED,
+                                    reason="brownout shed")
+                elif bo.degrades(req.slo_class):
+                    changed = False
+                    if req.spec:
+                        changed = rm.set_spec_mode(req.rid, False) \
+                            or changed
+                    cap = bo.output_cap(req.slo_class)
+                    if cap is not None:
+                        changed = rm.apply_output_cap(req.rid, cap) \
+                            or changed
+                    if changed and tel.enabled:
+                        tel.lane_degraded(req.slo_class)
+
     def _fleet_tick(self) -> None:
         """One routing pass: scheduled kills, rolling-migration advance,
-        queue dispatch, one tick per serving replica, quarantine
-        re-probes, health gauges."""
+        brownout evaluation, queue dispatch, one tick per serving
+        replica, quarantine re-probes, health gauges."""
         self.ticks += 1
         for name, at in list(self._kills.items()):
             if at <= self.ticks:
                 del self._kills[name]
                 self.kill_replica(name, reason="scheduled kill")
         self._advance_rolling()
+        self._maybe_brownout()
         self._dispatch_queue()
         for rep in self.replicas:
             if rep.state is ReplicaState.DEAD:
@@ -1022,6 +1324,10 @@ class FleetRouter:
             req = self.requests[rid]
             rec["tokens"] = req.generated
             rec["outcome"] = req.outcome or OUTCOMES.get(req.status, "ok")
+            if req.slo_class:
+                rec["slo_class"] = req.slo_class
+            if req.deferred_ticks:
+                rec["deferred_ticks"] = req.deferred_ticks
             rec["kv_bytes"] = req.kv_bytes
             rec["replica"] = self.placement.get(rid, "")
             rec["failovers"] = self._failover_counts.get(rid, 0)

@@ -103,7 +103,8 @@ class MigrationRollback(Exception):
 def build_deployment(built, gen, telemetry=None, resilience=None,
                      fault_injector=None, clock=None, profiler=None,
                      spec: Optional[Dict] = None, plan_key: str = "",
-                     default_shape: Optional[Tuple[int, int]] = None):
+                     default_shape: Optional[Tuple[int, int]] = None,
+                     slo=None, brownout=None):
     """Wrap a ``build_manager``-style result into a serving manager.
 
     THE one wrapping contract shared by :class:`MigrationController`'s
@@ -133,11 +134,12 @@ def build_deployment(built, gen, telemetry=None, resilience=None,
         return SpecInferManager(
             llm_im, ssm_im, gen, width=width, depth=depth,
             telemetry=telemetry, resilience=resilience,
-            fault_injector=fault_injector, clock=clock, profiler=profiler)
+            fault_injector=fault_injector, clock=clock, profiler=profiler,
+            slo=slo, brownout=brownout)
     return RequestManager(built, gen, telemetry=telemetry,
                           resilience=resilience,
                           fault_injector=fault_injector, clock=clock,
-                          profiler=profiler)
+                          profiler=profiler, slo=slo, brownout=brownout)
 
 
 @dataclasses.dataclass
@@ -483,7 +485,12 @@ class MigrationController:
             spec=candidate.get("spec"),
             plan_key=candidate.get("plan_key", ""),
             default_shape=((rm.width, rm.depth) if hasattr(rm, "width")
-                           else None))
+                           else None),
+            # the lane policy + ladder cross the switch like the
+            # telemetry handle — a migration must not silently
+            # deactivate SLO lanes on the successor
+            slo=getattr(rm, "slo", None),
+            brownout=getattr(rm, "brownout", None))
 
     def _readmit(self, rm: RequestManager, new_rm: RequestManager,
                  candidate: Dict) -> int:
@@ -509,6 +516,12 @@ class MigrationController:
             req.preemptions = old.preemptions
             req.requeues = old.requeues
             req.kv_bytes = old.kv_bytes
+            # SLO-lane identity crosses the switch: losing the class
+            # would resolve a latency_critical request to the DEFAULT
+            # (degradable) lane on the successor and let a brownout
+            # shed it — violating its shed_policy="never" contract
+            req.slo_class = old.slo_class
+            req.deferred_ticks = old.deferred_ticks
             req.generated = list(old.generated)
             req.prefill_src = (list(old.prefill_src)
                                if old.prefill_src is not None else None)

@@ -57,7 +57,8 @@ OUTCOMES = {
 # vocabulary/coercion for every arrival-driven loop (RequestManager and
 # the fleet router), so adding an option here reaches both and a
 # malformed dict rejects identically instead of drifting
-ARRIVAL_OPTION_KEYS = frozenset({"priority", "ttl_s", "deadline_s", "spec"})
+ARRIVAL_OPTION_KEYS = frozenset({"priority", "ttl_s", "deadline_s", "spec",
+                                 "slo_class"})
 
 
 def parse_arrival_options(rest) -> Tuple[Dict, Optional[str]]:
@@ -73,6 +74,7 @@ def parse_arrival_options(rest) -> Tuple[Dict, Optional[str]]:
     try:
         return {k: (int(v) if k == "priority"
                     else bool(v) if k == "spec"
+                    else str(v) if k == "slo_class"
                     else float(v))
                 for k, v in rest[0].items() if v is not None}, None
     except (TypeError, ValueError):
@@ -122,6 +124,14 @@ class Request:
     # under a plain RequestManager the flag is inert (everything rides the
     # incremental loop).
     spec: bool = False
+    # SLO-class lanes (serve/slo.py): the traffic class this request
+    # resolved to at registration ("" = no policy attached — every lane
+    # knob is inert).  ``deferred_ticks`` counts brownout windows the
+    # request spent queue-held at DEFER_BATCH or above (explicit,
+    # observable deferral — it still ends in a terminal outcome: ok,
+    # timeout, or a brownout-shed REJECTED, never FAILED).
+    slo_class: str = ""
+    deferred_ticks: int = 0
 
     @property
     def prefill_tokens(self) -> List[int]:
@@ -162,13 +172,16 @@ class RequestManager:
     def __init__(self, im, gen_config: Optional[GenerationConfig] = None,
                  telemetry=None, resilience: Optional[ResilienceConfig] = None,
                  fault_injector=None, clock=None, plan_health=None,
-                 profiler=None):
+                 profiler=None, slo=None, brownout=None):
         import time as _time
 
         self.im = im
         self.gen = gen_config or GenerationConfig()
         self.requests: Dict[int, Request] = {}
         self.pending: List[int] = []
+        # serve-step stamp of each rid's entry into ``pending`` — read
+        # by _pop_pending's bounded aging (starvation_bound_ticks)
+        self._pending_since: Dict[int, int] = {}
         self.slots: List[Optional[int]] = [None] * im.max_requests
         self._next_rid = 0
         self.steps = 0
@@ -258,6 +271,32 @@ class RequestManager:
         # takes a slot) so the drain converges
         self.migration = None
         self.admission_closed = False
+        # SLO-class lanes + brownout (serve/slo.py): an attached
+        # SLOPolicy classifies requests at registration (priority band,
+        # per-class bounded queue, reserved-KV-headroom gate); an
+        # attached BrownoutController is evaluated every
+        # ``config.check_every`` serve ticks and its level's actions
+        # (defer / degrade / shed of degradable classes) apply at tick
+        # boundaries.  Both default off — behavior is unchanged without
+        # them.  Under a FleetRouter the FLEET owns policy + controller
+        # (one ladder over the whole fleet); replicas get references for
+        # their queue gates but only the fleet EVALUATES the ladder
+        # (this manager's _maybe_brownout runs from its own serve loops,
+        # which the fleet never drives).
+        self.slo = slo
+        self.brownout = brownout
+        if brownout is not None and slo is None:
+            self.slo = brownout.policy
+        self._brownout_ticks = 0
+        # an attached monitor inherits the manager's lane policy (the
+        # per-class SLO checks) and ladder (batch breaches escalate
+        # brownout before recommending replan) unless wired explicitly —
+        # the same auto-wiring pattern as kv_allocator above
+        if plan_health is not None:
+            if getattr(plan_health, "slo", None) is None:
+                plan_health.slo = self.slo
+            if getattr(plan_health, "brownout", None) is None:
+                plan_health.brownout = self.brownout
 
     @staticmethod
     def _fold_for(req: Request) -> Tuple[int, int]:
@@ -349,6 +388,9 @@ class RequestManager:
         if res.max_pending is not None and len(self.pending) >= res.max_pending:
             return (f"pending queue full ({len(self.pending)} >= "
                     f"{res.max_pending})")
+        reason = self._lane_admission_reason(req)
+        if reason is not None:
+            return reason
         if res.kv_gate:
             per_tok = self._kv_bytes_per_token()
             if per_tok is None and res.kv_budget_bytes is not None:
@@ -389,6 +431,61 @@ class RequestManager:
                 return (f"KV headroom: {committed * per_tok / 2**20:.2f}"
                         f" MiB committed > {cap_bytes / 2**20:.2f} MiB "
                         "budget")
+            # reserved-lane gate (serve/slo.py): same budget, same
+            # rounded worst-case needs — each class's committed charges
+            # its own reservation first, only the overflow competes for
+            # the shared pool, so batch traffic can never consume the
+            # latency-critical lane's reservation
+            reason = self._lane_reservation_reason(
+                req, live, cap_bytes,
+                lambda r: rnd(self._seq_len_needed(r)) * per_tok)
+            if reason is not None:
+                return reason
+        return None
+
+    def _lane_reservation_reason(self, req: Request, live, budget: float,
+                                 price) -> Optional[str]:
+        """The per-class reserved-KV-headroom check (None without a
+        policy or when no class reserves anything).  ``price(r)`` is the
+        SAME worst-case-need arithmetic the total gate just used."""
+        slo = self.slo
+        if slo is None or not any(c.kv_reservation_frac
+                                  for c in slo.classes.values()):
+            return None
+        cls = slo.resolve(req.slo_class)
+        if cls is None:
+            return None
+        from .slo import reservation_reason
+
+        by_cls: Dict[str, float] = {}
+        for r in live:
+            rc = slo.resolve(r.slo_class)
+            key = rc.name if rc is not None else r.slo_class
+            by_cls[key] = by_cls.get(key, 0.0) + price(r)
+        return reservation_reason(slo, by_cls, cls, price(req), budget)
+
+    def _lane_admission_reason(self, req: Request) -> Optional[str]:
+        """Lane-level admission checks: the brownout ladder's admission
+        gate for degradable classes and the per-class bounded pending
+        queue.  None without a policy."""
+        if self.slo is None:
+            return None
+        cls = self.slo.resolve(req.slo_class)
+        if cls is None:
+            return None  # unknown class is caller invalidity, not capacity
+        bo = self.brownout
+        if bo is not None and not bo.admits(cls.name):
+            if self.telemetry.enabled:
+                self.telemetry.lane_shed(cls.name, trace_id=req.trace_id,
+                                         reason=f"brownout:{bo.level.name}")
+            return (f"brownout {bo.level.name}: class {cls.name!r} "
+                    "admissions shed")
+        if cls.max_pending is not None:
+            depth = sum(1 for rid in self.pending
+                        if self.requests[rid].slo_class == cls.name)
+            if depth >= cls.max_pending:
+                return (f"class {cls.name!r} pending queue full "
+                        f"({depth} >= {cls.max_pending})")
         return None
 
     def register_new_request(
@@ -397,6 +494,7 @@ class RequestManager:
         priority: int = 0, ttl_s: Optional[float] = None,
         deadline_s: Optional[float] = None, reject_invalid: bool = False,
         reject_reason: Optional[str] = None, spec: Optional[bool] = None,
+        slo_class: Optional[str] = None,
     ) -> int:
         """Register a request; returns its rid.
 
@@ -413,7 +511,13 @@ class RequestManager:
         an ``ok`` outcome and zero tokens.  ``spec`` sets the request's
         speculation mode (None = the manager's ``default_spec_mode``);
         meaningful under a :class:`~.spec_infer.SpecInferManager`, inert
-        otherwise.
+        otherwise.  ``slo_class`` names the request's traffic lane under
+        an attached :class:`~.slo.SLOPolicy` (None/"" = the policy's
+        default class; an unknown name is caller invalidity, rejected
+        like a bad shape); the class's priority band adds to
+        ``priority``, its brownout/queue/reservation gates apply, and an
+        in-force DEGRADE_BATCH output cap truncates ``max_new_tokens``
+        at admission.
         """
         req = self.request_cls(
             -1,
@@ -421,17 +525,28 @@ class RequestManager:
             self.gen.max_new_tokens if max_new_tokens is None else int(max_new_tokens),
         )
         req.spec = bool(self.default_spec_mode if spec is None else spec)
+        band = 0
+        if self.slo is not None:
+            cls = self.slo.resolve(slo_class)
+            if cls is None:
+                req.slo_class = str(slo_class)
+            else:
+                req.slo_class = cls.name
+                band = cls.priority_band
         # reject_reason: caller-side invalidity (e.g. malformed arrival
         # options) that must take the REJECTED path like any shape error
         err = reject_reason if reject_reason is not None \
             else self._validate_request(req)
+        if err is None and self.slo is not None \
+                and self.slo.resolve(slo_class) is None:
+            err = f"unknown slo_class {slo_class!r}"
         if err is not None and not reject_invalid:
             raise ValueError(err)
         rid = self._next_rid
         self._next_rid += 1
         req.rid = rid
         req.trace_id = f"r{rid:05d}"
-        req.priority = int(priority)
+        req.priority = int(priority) + band
         self.requests[rid] = req
         tel = self.telemetry
         if tel.enabled:
@@ -456,8 +571,25 @@ class RequestManager:
             req.status = RequestStatus.COMPLETED
             req.outcome = "ok"
             if tel.enabled:
-                tel.request_finished(req.trace_id, n_tokens=0)
+                tel.request_finished(req.trace_id, n_tokens=0,
+                                     slo_class=req.slo_class or None)
             return rid
+        if self.brownout is not None and self.brownout.degrades(
+                req.slo_class):
+            # DEGRADE_BATCH in force: admit, but speculation off and the
+            # class's output cap applied up front (truncation only — the
+            # served tokens stay a bit-identical PREFIX of the unloaded
+            # run's stream).  Counted only when something actually
+            # changed — lane_degraded_total is in bench_compare's exact
+            # class, so a no-op "degradation" must not inflate it
+            changed = req.spec
+            req.spec = False
+            cap = self.brownout.output_cap(req.slo_class)
+            if cap is not None and cap < req.max_new_tokens:
+                req.max_new_tokens = cap
+                changed = True
+            if changed and tel.enabled:
+                tel.lane_degraded(req.slo_class)
         if deadline_s is not None:
             req.deadline_s = float(deadline_s)
         else:
@@ -465,6 +597,7 @@ class RequestManager:
             if ttl is not None:
                 req.deadline_s = self.clock() + float(ttl)
         self.pending.append(rid)
+        self._pending_since[rid] = self.steps
         return rid
 
     # ------------------------------------------------------------------
@@ -534,10 +667,15 @@ class RequestManager:
         retries."""
         if req.rid in self.pending:
             self.pending.remove(req.rid)
+        self._pending_since.pop(req.rid, None)
         self._release_slot(req)
         req.prefill_src = None  # recompute feed is dead weight once terminal
         req.status = status
         req.outcome = OUTCOMES[status]
+        if status is RequestStatus.REJECTED:
+            # post-registration shed (brownout): same contract as the
+            # admission path — shed load must not grow host memory
+            req.prompt = []
         tel = self.telemetry
         if tel.enabled:
             n = len(req.generated)
@@ -545,6 +683,9 @@ class RequestManager:
                 tel.request_cancelled(req.trace_id, n_tokens=n)
             elif status is RequestStatus.TIMED_OUT:
                 tel.request_timed_out(req.trace_id, n_tokens=n)
+            elif status is RequestStatus.REJECTED:
+                tel.request_rejected(req.trace_id,
+                                     reason=site or "brownout shed")
             elif status is RequestStatus.FAILED:
                 tel.request_failed(req.trace_id, site=site)
 
@@ -612,6 +753,9 @@ class RequestManager:
         req.status = RequestStatus.PREEMPTED
         req.preemptions += 1
         self.pending.append(rid)
+        # the aging clock restarts on preemption: it measures time
+        # waiting for THIS admission, not lifetime
+        self._pending_since[rid] = self.steps
         tel = self.telemetry
         if tel.enabled:
             tel.request_preempted(req.trace_id,
@@ -726,17 +870,70 @@ class RequestManager:
                      [(rid, hi - lo, hi) for rid, lo, hi in spans],
                      passes=passes, logit_rows=logit_rows)
 
-    def _pop_pending(self) -> int:
-        """Highest-priority pending rid, FIFO within a priority class."""
-        best = max(range(len(self.pending)),
+    # bounded aging for the priority queue (the fleet router sets this
+    # from ``FleetConfig.starvation_bound_ticks`` on every replica): a
+    # request pending longer than this many serve steps becomes OVERDUE
+    # and is admitted ahead of every priority band (FIFO among overdue),
+    # so a lower-priority class behind a sustained higher-priority
+    # stream is starved only up to the bound.  None (the single-manager
+    # default) keeps the historical strict-priority behavior.  A
+    # brownout DEFER hold is exempt — an explicit policy state with its
+    # own hysteresis-bounded exit, not priority competition.
+    starvation_bound_ticks: Optional[int] = None
+
+    def _held(self, req: Request) -> bool:
+        """DEFER_BATCH semantics: is this queued request held out of
+        engine slots by the brownout ladder this tick?  (Explicit policy
+        hold — distinct from priority starvation, which the bounded
+        aging above caps.)"""
+        return (self.brownout is not None
+                and self.brownout.holds(req.slo_class))
+
+    def _pop_pending(self) -> Optional[int]:
+        """Highest-priority ELIGIBLE pending rid, FIFO within a priority
+        class — except OVERDUE requests (pending past the aging bound),
+        which jump every band, oldest first.  None when every pending
+        request is brownout-held."""
+        cands = []
+        for i in range(len(self.pending)):
+            if self._held(self.requests[self.pending[i]]):
+                # hold time is EXEMPT from aging (the documented
+                # contract): re-stamp so the age measures only time
+                # spent losing priority competition, not policy holds —
+                # otherwise a long DEFER would mark the whole held
+                # backlog overdue and batch would jump the
+                # latency-critical lane exactly at recovery
+                self._pending_since[self.pending[i]] = self.steps
+            else:
+                cands.append(i)
+        if not cands:
+            return None
+        bound = self.starvation_bound_ticks
+        if bound is not None:
+            # setdefault: rids whose entry was not stamped (e.g. a
+            # migration successor's wholesale pending list) start aging
+            # from their first admission attempt
+            overdue = [i for i in cands
+                       if self.steps - self._pending_since.setdefault(
+                           self.pending[i], self.steps) >= bound]
+            if overdue:
+                best = min(overdue,
+                           key=lambda i: (self._pending_since.get(
+                               self.pending[i], self.steps), i))
+                self._pending_since.pop(self.pending[best], None)
+                return self.pending.pop(best)
+        best = max(cands,
                    key=lambda i: (self.requests[self.pending[i]].priority,
                                   -i))
+        self._pending_since.pop(self.pending[best], None)
         return self.pending.pop(best)
 
     def _fill_slots(self):
         for i, occupant in enumerate(self.slots):
             if occupant is None and self.pending:
                 rid = self._pop_pending()
+                if rid is None:
+                    break  # everything pending is brownout-held
                 req = self.requests[rid]
                 req.slot = i
                 req.status = RequestStatus.PREFILLING
@@ -761,7 +958,12 @@ class RequestManager:
         slot is free.  Returns whether an eviction happened."""
         if not self.pending or any(s is None for s in self.slots):
             return False
-        head_pri = max(self.requests[r].priority for r in self.pending)
+        # brownout-held requests can neither take a slot nor evict for one
+        eligible = [r for r in self.pending
+                    if not self._held(self.requests[r])]
+        if not eligible:
+            return False
+        head_pri = max(self.requests[r].priority for r in eligible)
         victims = [r for r in self._active()
                    if r.status is RequestStatus.DECODING
                    and r.priority < head_pri
@@ -987,7 +1189,8 @@ class RequestManager:
             now = tel.request_first_token(
                 req.trace_id,
                 ttft_s=(tel.now() - ts["enqueue"]
-                        if "enqueue" in ts else None))
+                        if "enqueue" in ts else None),
+                slo_class=req.slo_class or None)
             ts["first_token"] = now
 
     def process_result(self, result, sample_points) -> None:
@@ -1036,7 +1239,8 @@ class RequestManager:
                     tpot_s=((now - first)
                             / max(len(req.generated) - 1, 1)
                             if first is not None else None),
-                    kv_bytes=req.kv_bytes or None)
+                    kv_bytes=req.kv_bytes or None,
+                    slo_class=req.slo_class or None)
 
     # ------------------------------------------------------------------
     def _scan_steps_possible(self) -> int:
@@ -1448,6 +1652,94 @@ class RequestManager:
         if force or self._health_ticks % self.health_check_every == 0:
             self.plan_health.check()
 
+    def apply_output_cap(self, rid: int, cap: int) -> bool:
+        """Cap a live request's ``max_new_tokens`` (DEGRADE_BATCH): the
+        committed stream stays a bit-identical PREFIX of the uncapped
+        run.  A request already at/past the cap completes at this tick
+        boundary with its committed tokens and an ``ok`` outcome.
+        Returns whether the cap shortened the request."""
+        req = self.requests.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES:
+            return False
+        new_max = max(int(cap), len(req.generated))
+        if new_max >= req.max_new_tokens:
+            return False
+        req.max_new_tokens = new_max
+        if req.status is RequestStatus.DECODING \
+                and len(req.generated) >= req.max_new_tokens:
+            self._maybe_finish(req)
+        return True
+
+    def _maybe_brownout(self) -> None:
+        """Evaluate an attached BrownoutController every
+        ``config.check_every`` serve ticks and apply the level's actions
+        at this tick boundary (see serve/slo.py for the ladder).  Owned
+        by the FLEET when serving under a FleetRouter (per-replica
+        managers keep ``brownout`` None)."""
+        bo = self.brownout
+        if bo is None:
+            return
+        self._brownout_ticks += 1
+        if self._brownout_ticks % bo.config.check_every:
+            return
+        slo = self.slo
+        tel = self.telemetry
+        kv = getattr(self.im, "kv", None)
+        occ = (kv.live_tokens() / kv.capacity_tokens
+               if kv is not None and kv.capacity_tokens else 0.0)
+        depths: Dict[str, int] = {c: 0 for c in slo.classes}
+        lc_depth = 0
+        for rid in self.pending:
+            req = self.requests[rid]
+            cls = slo.resolve(req.slo_class)
+            if cls is None:
+                continue
+            depths[cls.name] = depths.get(cls.name, 0) + 1
+            if not cls.degradable:
+                lc_depth += 1
+        if tel.enabled:
+            tel.lane_depths(depths)
+        bo.evaluate(lc_queue_depth=lc_depth, kv_occupancy_frac=occ)
+        if bo.level == 0:
+            return
+        # --- apply the level's actions (idempotent per window) ---------
+        deferred: Dict[str, int] = {}
+        for rid in list(self.pending):
+            req = self.requests[rid]
+            if req.status in TERMINAL_STATUSES:
+                continue
+            if bo.sheds_queued(req.slo_class):
+                if tel.enabled:
+                    tel.lane_shed(req.slo_class, trace_id=req.trace_id,
+                                  reason=f"brownout:{bo.level.name}")
+                self._terminate(req, RequestStatus.REJECTED)
+            elif self._held(req):
+                req.deferred_ticks += 1
+                deferred[req.slo_class] = deferred.get(req.slo_class, 0) + 1
+        if tel.enabled:
+            for cname, cnt in deferred.items():
+                tel.lane_deferred(cname, count=cnt)
+        for req in list(self._active()):
+            if bo.sheds_live(req.slo_class):
+                # CRITICAL_ONLY: evict and shed even slotted degradable
+                # work — explicit REJECTED (committed tokens stay on the
+                # record), never FAILED
+                self._release_slot(req)
+                if tel.enabled:
+                    tel.lane_shed(req.slo_class, trace_id=req.trace_id,
+                                  reason="brownout:CRITICAL_ONLY")
+                self._terminate(req, RequestStatus.REJECTED)
+            elif bo.degrades(req.slo_class):
+                changed = False
+                if req.spec:
+                    # the r14 runtime flip: spec off for degraded lanes
+                    changed = self.set_spec_mode(req.rid, False) or changed
+                cap = bo.output_cap(req.slo_class)
+                if cap is not None:
+                    changed = self.apply_output_cap(req.rid, cap) or changed
+                if changed and tel.enabled:
+                    tel.lane_degraded(req.slo_class)
+
     def _maybe_migrate(self, idle: bool = False):
         """Tick-boundary slot for an attached
         :class:`~flexflow_tpu.serve.migration.MigrationController`:
@@ -1591,6 +1883,7 @@ class RequestManager:
                 self.profiler.tick_end()
                 self._sync_kv()
                 self._maybe_check_health()
+                self._maybe_brownout()
                 for rid in starters:
                     if self.requests[rid].prefill_offset > 0:
                         records[rid]["prefill_start_s"] = now
@@ -1610,6 +1903,13 @@ class RequestManager:
             req = self.requests[rid]
             rec["tokens"] = req.generated
             rec["outcome"] = req.outcome or OUTCOMES.get(req.status, "ok")
+            # SLO-class lanes (serve/slo.py): the lane the request rode
+            # and how many brownout windows it spent queue-held — the
+            # per-class report breakdown keys on these
+            if req.slo_class:
+                rec["slo_class"] = req.slo_class
+            if req.deferred_ticks:
+                rec["deferred_ticks"] = req.deferred_ticks
             # byte-side attribution: peak committed-KV this request held
             # (0.0 for rejected/never-slotted requests)
             rec["kv_bytes"] = req.kv_bytes
@@ -1656,6 +1956,7 @@ class RequestManager:
             self.profiler.tick_end()
             self._sync_kv()
             self._maybe_check_health()
+            self._maybe_brownout()
             new_rm = self._maybe_migrate()
             if new_rm is not None:
                 return new_rm.serve_incr_decoding()

@@ -128,11 +128,14 @@ class SpecInferManager(RequestManager):
         clock=None,
         plan_health=None,
         profiler=None,
+        slo=None,
+        brownout=None,
     ):
         super().__init__(llm, gen_config, telemetry=telemetry,
                          resilience=resilience,
                          fault_injector=fault_injector, clock=clock,
-                         plan_health=plan_health, profiler=profiler)
+                         plan_health=plan_health, profiler=profiler,
+                         slo=slo, brownout=brownout)
         self.llm = llm
         self.ssm = ssm
         self.width = width

@@ -54,15 +54,22 @@ def _counter_keys():
     global _COUNTER_KEYS
     if _COUNTER_KEYS is None:
         from flexflow_tpu.obs.profiler import WORK_COUNTERS
-        from flexflow_tpu.obs.telemetry import FLEET_REGRESSION_COUNTERS
+        from flexflow_tpu.obs.telemetry import (
+            FLEET_REGRESSION_COUNTERS,
+            SLO_REGRESSION_COUNTERS,
+        )
 
         # fleet robustness counters join the deterministic-exact class:
         # a hermetic fleet run's failovers/quarantines/deaths are a pure
         # function of the seeded schedule, so any increase between two
         # runs of the same workload means the fleet got less robust
-        # (more replicas failing per served token)
+        # (more replicas failing per served token).  Same for the
+        # SLO-lane counters (serve/slo.py): more shed/deferred requests
+        # or more brownout escalations for the same seeded overload
+        # means the lanes degrade less gracefully.
         _COUNTER_KEYS = frozenset(WORK_COUNTERS) \
-            | frozenset(FLEET_REGRESSION_COUNTERS)
+            | frozenset(FLEET_REGRESSION_COUNTERS) \
+            | frozenset(SLO_REGRESSION_COUNTERS)
     return _COUNTER_KEYS
 
 

@@ -42,6 +42,16 @@ moving off a failed replica onto a survivor under its original rid),
 and the exact ``FLEET_COUNTERS`` registry view (``failovers_total``,
 ``replica_deaths``, the ``fleet_replicas_*`` gauges).
 
+The ``slo`` section is the serving-lanes view (serve/slo.py):
+``brownout_level_changed`` ladder transitions (level, from_level, the
+pressure reason), explicit ``lane_shed`` events per degradable-class
+request the ladder rejected, the exact ``SLO_COUNTERS`` registry view
+(deferral/shed/degrade totals, escalation/de-escalation counts, the
+``brownout_level`` gauge), and the per-class ``lane_pending_depth_*``
+gauges.  Per-class TTFT/TPOT attainment lives in the
+``under_load_summary`` ``per_class`` breakdown the bench sections
+carry.
+
 A trace whose ring buffer dropped events is TRUNCATED — the summary is
 computed from what survived — so ``dropped > 0`` prints an explicit
 warning to stderr (satellite of ISSUE 6: a truncated trace must not

@@ -446,6 +446,61 @@ def test_fleet_cancel_and_ttl_reach_terminal():
     assert fleet.replicas[0].rm.im.kv.attributed_rids() == []
 
 
+@pytest.mark.chaos
+@pytest.mark.overload
+@pytest.mark.parametrize("gen_fn", [greedy, seeded],
+                         ids=["greedy", "seeded"])
+def test_replica_death_during_brownout_composes(gen_fn):
+    """The death matrix x the ladder (ISSUE 15 satellite): a replica is
+    killed mid-decode while a brownout is ACTIVE — failover, deferral/
+    shed, and de-escalation compose: admitted latency-critical streams
+    stay bit-identical to a fault-free single-replica run, every
+    outcome is terminal and explicit (never FAILED), the dead replica
+    tears down leak-free, and the ladder still walks back to NORMAL."""
+    from flexflow_tpu.serve import (
+        BrownoutConfig,
+        BrownoutController,
+        BrownoutLevel,
+        SLOPolicy,
+    )
+
+    want = baseline(gen_fn, PROMPTS)
+    pol = SLOPolicy.default(lc_reservation_frac=0.0)
+    bo = BrownoutController(
+        pol, BrownoutConfig(check_every=1, queue_depth_high=0,
+                            escalate_after=1, deescalate_after=4))
+    fleet = FleetRouter([fresh_im() for _ in range(2)], gen=gen_fn(),
+                        slo=pol, brownout=bo)
+    for rep in fleet.replicas:
+        rep.rm.scan_chunk = 2
+    seen = kill_spy(fleet)
+    fleet.schedule_kill("replica0", at_tick=3)
+    # latency-critical lane + batch lane; the lc queue pressure armed by
+    # the burst escalates the ladder before the kill lands
+    rids = [fleet.register(PROMPTS[0], 8, slo_class="latency_critical"),
+            fleet.register(PROMPTS[1], 8, slo_class="latency_critical"),
+            fleet.register(PROMPTS[2], 8)]
+    out = fleet.serve_all()
+    assert bo.history, "the burst never escalated the ladder"
+    assert seen["statuses"], "the kill did not catch in-flight work"
+    # admitted latency-critical streams are bit-identical to the
+    # fault-free run despite riding a failover under an active brownout
+    assert out[rids[0]] == want[0]
+    assert out[rids[1]] == want[1]
+    # the batch request's stream (deferred, maybe failed over) is a
+    # prefix of the fault-free run's — never corrupted
+    assert out[rids[2]] == want[2][:len(out[rids[2]])]
+    # all terminal + explicit; shed-or-served, never FAILED
+    for rid in rids:
+        assert fleet.requests[rid].status in (RequestStatus.COMPLETED,
+                                              RequestStatus.REJECTED)
+    dead = fleet._by_name("replica0")
+    assert dead.state is ReplicaState.DEAD
+    assert dead.leaked == [], "dead replica leaked KV attribution"
+    # load drained: the ladder de-escalated back to NORMAL
+    assert bo.level == BrownoutLevel.NORMAL
+
+
 def test_fleet_telemetry_off_is_bit_identical():
     want = baseline(greedy, PROMPTS)
     tel = Telemetry(clock=VirtualClock(0.001))

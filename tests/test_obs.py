@@ -331,7 +331,7 @@ def test_every_emitted_typed_event_is_in_event_schema():
     for path in sources:
         with open(path) as f:
             for name, cat in pat.findall(f.read()):
-                if cat in ("request", "dispatch", "plan", "fleet"):
+                if cat in ("request", "dispatch", "plan", "fleet", "slo"):
                     emitted.add((name, cat))
     assert emitted, "grep found no typed emitters — the pattern broke"
     unknown = {(n, c) for n, c in emitted
@@ -343,3 +343,6 @@ def test_every_emitted_typed_event_is_in_event_schema():
     # fleet serving (serve/fleet.py): the replica health vocabulary
     assert ("replica_dead", "fleet") in emitted
     assert ("request_failed_over", "request") in emitted
+    # SLO-class lanes + brownout (serve/slo.py): the new "slo" category
+    assert ("brownout_level_changed", "slo") in emitted
+    assert ("lane_shed", "slo") in emitted

@@ -378,6 +378,55 @@ def test_dry_run_step_profile_reconciles_per_component(dryrun):
     assert reported["time_budget"] == tb
 
 
+def test_dry_run_slo_overload_demonstrates_graceful_degradation(dryrun):
+    """ISSUE 15 acceptance: under 2x Poisson overload the latency-
+    critical class holds its p95 TTFT/TPOT targets while the batch
+    class degrades through the ladder with only explicit outcomes,
+    admitted requests are bit-identical (greedy + seeded) to an
+    unloaded run, batch KV never dips into the latency-critical
+    reservation, and the controller de-escalates to NORMAL with zero
+    flapping — all riding the real ``slo`` schema through
+    ``scripts/trace_report.py``."""
+    _, doc = dryrun
+    so = doc["observability"]["slo_overload"]
+    for variant in (so, so["seeded"]):
+        assert variant["bit_identical_prefixes"], \
+            "admitted streams diverged from the unloaded run"
+        assert variant["lc_streams_exact"]
+        assert variant["lc_slo_held"], (
+            variant["lc_ttft_p95_ms"], variant["lc_tpot_p95_ms"])
+        assert variant["batch_never_failed"]
+        assert set(variant["batch_outcomes"]) <= {"ok", "rejected",
+                                                  "timeout"}
+        assert variant["reservation_respected"]
+        assert variant["batch_kv_hwm_tokens"] \
+            <= variant["batch_kv_cap_tokens"]
+        assert variant["deescalated_to_normal"] and variant["no_flap"]
+        # the ladder genuinely walked: up past DEFER and back down
+        assert variant["ladder"][0] == "DEFER_BATCH"
+        assert variant["peak_level"] in ("SHED_BATCH", "CRITICAL_ONLY")
+        assert variant["ladder"][-1] == "NORMAL"
+        assert variant["deferred_requests"] > 0
+    # deterministic lane counters (bench_compare's exact class) + the
+    # slo section round-trips through the report
+    assert so["counters"]["lane_shed_total"] > 0
+    assert so["counters"]["lane_deferred_total"] > 0
+    assert so["counters"]["brownout_escalations"] \
+        == so["counters"]["brownout_deescalations"]
+    s = so["summary"]
+    assert s["slo"]["brownout_changes"], "no ladder events in the export"
+    assert s["slo"]["lane_shed"]
+    assert s["slo"]["counters"]["lane_shed_total"] \
+        == so["counters"]["lane_shed_total"]
+    ul = so["under_load"]
+    assert set(ul["per_class"]) >= {"latency_critical", "batch"}
+    # the CLI reproduces the summary from the JSONL alone
+    reported = json.loads(_run(
+        [os.path.join(REPO, "scripts", "trace_report.py"),
+         so["paths"]["jsonl"]]))
+    assert reported == s, "trace_report.py diverged on slo events"
+
+
 def test_dry_run_artifact_guards_with_bench_compare(dryrun, tmp_path):
     """The regression comparator is the loop's guardrail: the dry-run
     section compares clean against itself and trips on an injected
@@ -415,7 +464,8 @@ def test_check_mode_validates_dry_run_schema(dryrun):
                   doc["observability"]["spec_serving"]["paths"]["jsonl"],
                   doc["observability"]["live_migration"]["paths"]["jsonl"],
                   doc["observability"]["step_profile"]["paths"]["jsonl"],
-                  doc["observability"]["fleet_serving"]["paths"]["jsonl"]):
+                  doc["observability"]["fleet_serving"]["paths"]["jsonl"],
+                  doc["observability"]["slo_overload"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
