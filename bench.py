@@ -2287,6 +2287,13 @@ def slo_overload_dryrun(out_dir=None):
             resilience=(ResilienceConfig(kv_gate=True)
                         if slo is not None else None),
             slo=slo, brownout=bo)
+        # The ladder walk here is calibrated against tick-paced decode:
+        # chained stretches drain this mix without ever saturating to
+        # SHED (the chained engine's throughput is the host_tick
+        # section's job), so pin the legacy per-tick path for a stable
+        # escalation walk.
+        for rep in fleet.replicas:
+            rep.rm.chain_segments = False
         records = fleet.serve_with_arrivals(list(arrivals), clock=_Tick())
         return fleet, bo, records
 
@@ -2388,6 +2395,152 @@ def slo_overload_dryrun(out_dir=None):
     }
 
 
+def host_tick_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` host-tick elimination section
+    (serve/request_manager.py chained decode stretches): the SAME seeded
+    Poisson arrival stream served twice on the virtual clock — once on
+    the legacy per-tick loop pinned to ``quantum=1`` (one host round
+    trip per token), once on the chained engine (admission, slot joins
+    and lifecycle exit ride the device dispatch chain; ONE host sync per
+    stretch) — demonstrating the acceptance contract with no device
+    work:
+
+    * **bit-identity**: every request's token stream matches the legacy
+      run exactly, greedy AND seeded (the ``(rid, token_index)`` sample
+      fold makes the stream a pure function of the request, not the
+      schedule);
+    * **host-sync collapse**: the chained run does exactly one readback
+      per decode stretch (``host_syncs_per_stretch == 1``) where the
+      quantum-1 loop pays one per token;
+    * **dispatch amortization**: ``dispatches_per_token`` drops with the
+      stretch length (``<= 1/stretch`` for pure decode);
+    * **zero steady-state recompiles**: a second identical serve on the
+      same InferenceManager compiles nothing.
+
+    The exported JSONL rides the real ``step_profile`` schema (the
+    chained run's per-tick notes carry ``decode_quantum`` /
+    ``stretch_segments`` / ``stretch_joins``) and round-trips through
+    ``scripts/trace_report.py --check``; the per-unit ratios join
+    ``bench_compare``'s exact class via
+    ``obs.telemetry.HOST_TICK_REGRESSION_COUNTERS``.
+    """
+    import os
+
+    from flexflow_tpu.obs import StepProfiler, Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl
+    from flexflow_tpu.serve import GenerationConfig, RequestManager
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+
+    def tiny_im():
+        return build_im(False, layers=2, hidden=32, heads=2, kv=2, inter=48,
+                        vocab=64, max_requests=2, max_seq=64, max_tokens=16)
+
+    # seeded open-loop Poisson stream: gaps wide enough that decode
+    # stretches are in flight when the next request lands (mid-stretch
+    # joins), tight enough that slots stay contended; VARIED max-new
+    # budgets stagger the per-row remaining counts so stretches chain
+    # segments past the shortest row's device-side exit instead of the
+    # whole batch finishing in lockstep
+    rng = np.random.RandomState(11)
+    arrivals = [(0.0, [int(x) for x in rng.randint(1, 63, size=5)], 24)]
+    t = 0.0
+    for _ in range(9):
+        t += float(rng.exponential(1.0 / 200.0))
+        prompt = [int(x) for x in rng.randint(1, 63, size=rng.randint(3, 7))]
+        arrivals.append((t, prompt, int(rng.randint(4, 14))))
+
+    def serve(gen, chained, telemetry=None, im=None, rm_out=None):
+        im = im or tiny_im()
+        prof = StepProfiler(clock=_Tick())
+        rm = RequestManager(im, gen, telemetry=telemetry, profiler=prof)
+        if not chained:
+            rm.chain_segments = False
+        # per-stretch counter sampling: exact host syncs / dispatches
+        # attributable to each decode stretch
+        stretch_syncs, stretch_disp = [], []
+        inner = rm._decode_stretch
+
+        def sampled(n):
+            s0, d0 = prof.work["host_syncs"], prof.work["dispatches"]
+            inner(n)
+            stretch_syncs.append(prof.work["host_syncs"] - s0)
+            stretch_disp.append(prof.work["dispatches"] - d0)
+
+        rm._decode_stretch = sampled
+        recs = rm.serve_with_arrivals(
+            list(arrivals), clock=_Tick(),
+            **({"quantum": 1} if not chained else {}))
+        if rm_out is not None:
+            rm_out.append(rm)
+        toks = {rid: recs[rid]["tokens"] for rid in sorted(recs)}
+        total = sum(len(ts) for ts in toks.values())
+        work = dict(prof.work)
+        stats = {
+            "requests": len(recs),
+            "total_tokens": total,
+            "dispatches": work["dispatches"],
+            "host_syncs": work["host_syncs"],
+            "recompiles_total": work["recompiles_total"],
+            "decode_stretches": len(stretch_syncs),
+            "dispatches_per_token": round(work["dispatches"] / total, 4),
+            "host_syncs_per_token": round(work["host_syncs"] / total, 4),
+            "host_overhead_ms": round(
+                (prof.phase_s.get("host_prepare", 0.0)
+                 + prof.phase_s.get("host_admit", 0.0)) * 1e3, 6),
+        }
+        if chained and stretch_syncs:
+            stats["host_syncs_per_stretch"] = round(
+                sum(stretch_syncs) / len(stretch_syncs), 4)
+            stats["max_syncs_per_stretch"] = max(stretch_syncs)
+            stats["dispatches_per_stretch"] = round(
+                sum(stretch_disp) / len(stretch_disp), 4)
+        return toks, stats, im
+
+    variants = {}
+    tel = None
+    for mode, gen in (("greedy", GenerationConfig(max_new_tokens=10)),
+                      ("seeded", GenerationConfig(max_new_tokens=10,
+                                                  temperature=0.8,
+                                                  top_p=0.9, seed=7))):
+        toks_legacy, legacy, im_l = serve(gen, chained=False)
+        release_im(im_l)
+        vtel = Telemetry(clock=_Tick()) if mode == "greedy" else None
+        toks_chain, chain, im_c = serve(gen, chained=True, telemetry=vtel)
+        if vtel is not None:
+            tel = vtel
+            joins = vtel.metrics.snapshot().get("stretch_joins", 0)
+            chain["stretch_joins"] = joins
+            # steady state: an identical second serve on the SAME
+            # InferenceManager must hit the jit caches — zero recompiles
+            im_c.reset()
+            _, warm, _ = serve(gen, chained=True, im=im_c)
+            chain["steady_state_recompiles"] = warm["recompiles_total"]
+        release_im(im_c)
+        variants[mode] = {
+            "bit_identical": toks_legacy == toks_chain,
+            "legacy_quantum1": legacy,
+            "chained": chain,
+        }
+
+    paths = tel.export(out_dir, prefix="dryrun_host_tick")
+    summary = summarize_jsonl(paths["jsonl"])
+    return {
+        "paths": paths,
+        "summary": summary,
+        **variants["greedy"],
+        "seeded": variants["seeded"],
+        "note": "same seeded Poisson stream, legacy quantum-1 loop vs "
+                "chained decode stretches on the virtual clock: token "
+                "streams bit-identical (greedy AND seeded), exactly one "
+                "host sync per decode stretch vs one per token, "
+                "dispatches amortized across the stretch, and a second "
+                "identical serve on the same manager recompiles nothing; "
+                "dispatches_per_token / host_syncs_per_stretch are "
+                "bench_compare exact-class fields",
+    }
+
+
 def bench_shared_prefix(ctx=256, n_users=16, shared_len=1536,
                         suffix_len=128, max_new=32, page=512):
     """DEVICE shared-prefix serving section: N users x one system prompt,
@@ -2469,6 +2622,7 @@ def main(argv=None):
         doc["observability"]["fleet_serving"] = fleet_serving_dryrun(
             args.out)
         doc["observability"]["slo_overload"] = slo_overload_dryrun(args.out)
+        doc["observability"]["host_tick"] = host_tick_dryrun(args.out)
         print(json.dumps(doc))
         return
 

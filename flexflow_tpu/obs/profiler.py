@@ -12,8 +12,9 @@ decomposed half:
   (``RequestManager(..., profiler=StepProfiler())``; the manager syncs it
   onto the InferenceManager / every pipeline stage).  It
 
-  - **times each serve tick's phases** on the injectable clock: host
-    batch preparation (``host_prepare``), jit dispatch (``dispatch``;
+  - **times each serve tick's phases** on the injectable clock:
+    admission/slot-fill/arrival parsing (``host_admit``), host batch
+    preparation (``host_prepare``), jit dispatch (``dispatch``;
     per-stage ``stage{i}`` under pp), the inter-stage activation hop
     (``hop``), and the sample readback (``readback``) — the host-side
     time-budget decomposition of a tick;
@@ -251,6 +252,9 @@ class StepProfiler:
         self._paged: Dict[int, Tuple[object, Dict[str, int]]] = {}
         self._cards: Dict[int, PlanCostCard] = {}
         self._tick_mark: Optional[Dict] = None
+        # scheduling annotations for the CURRENT tick (note()): merged
+        # into the tick's step_profile instant and last_tick, then cleared
+        self._tick_notes: Dict[str, float] = {}
 
     # ---- wiring -------------------------------------------------------
     def bind(self, telemetry) -> None:
@@ -272,7 +276,7 @@ class StepProfiler:
         self._installed.add(key)
         label = type(im).__name__
         jits = self._jits.setdefault(key, [])
-        for name in ("_step", "_scan", "_pscan", "_advance"):
+        for name in ("_step", "_scan", "_pscan", "_advance", "_join"):
             fn = getattr(im, name, None)
             if fn is not None and hasattr(fn, "_cache_size"):
                 jits.append((f"{label}{name}", fn, fn._cache_size()))
@@ -328,6 +332,15 @@ class StepProfiler:
         """One device→host result materialization (np.asarray of a
         dispatch's output)."""
         self.work["host_syncs"] += n
+
+    def note(self, **kw) -> None:
+        """Stamp scheduling decisions into the CURRENT tick's
+        ``step_profile`` record (e.g. ``decode_quantum`` — the stretch
+        length the scheduler chose — or ``stretch_segments`` /
+        ``stretch_joins``).  Values must be JSON-scalar; keys are merged
+        into the tick instant at ``tick_end`` and cleared per tick, so
+        they never accumulate across ticks."""
+        self._tick_notes.update(kw)
 
     def account(self, card: PlanCostCard,
                 rows: Sequence[Tuple[int, int, int]],
@@ -412,12 +425,16 @@ class StepProfiler:
                             - mark["phase_s"].get(k, 0.0)) * 1e3, 6)
                   for k in self.phase_s
                   if self.phase_s[k] != mark["phase_s"].get(k, 0.0)}
+        notes = self._tick_notes
+        self._tick_notes = {}
         self.last_tick = {"tick": self.ticks, "work": dwork,
                           "phases_ms": dphase}
+        if notes:
+            self.last_tick["notes"] = notes
         tel = self.telemetry
         if tel is not None and tel.enabled:
             tel.instant("step_profile", cat="profile", track="profile",
-                        tick=self.ticks, **dwork)
+                        tick=self.ticks, **notes, **dwork)
             tel.metrics.gauge("recompiles_total").set(
                 self.work["recompiles_total"])
 
@@ -469,6 +486,9 @@ class NullStepProfiler:
         return None
 
     def host_sync(self, *a, **k):
+        return None
+
+    def note(self, *a, **k):
         return None
 
     def account(self, *a, **k):

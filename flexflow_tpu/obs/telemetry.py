@@ -173,6 +173,19 @@ SLO_REGRESSION_COUNTERS = (
     "brownout_escalations",
 )
 
+# Host-tick elimination ratios (on-device continuous batching,
+# serve/request_manager.py chained decode stretches).  Raw ``dispatches``
+# and ``host_syncs`` are already exact-class via WORK_COUNTERS; these are
+# the DERIVED per-unit ratios the ``host_tick`` bench section emits —
+# deterministic on the virtual clock and monotone bad-if-increasing
+# (more dispatches per token or host syncs per stretch means the host
+# tick crept back in), so bench_compare compares them exactly too.
+# ``stretch_joins`` (mid-stretch slot joins) is reported but stays out
+# of the regression class — its direction depends on the arrival mix.
+HOST_TICK_REGRESSION_COUNTERS = (
+    "dispatches_per_token", "host_syncs_per_stretch",
+)
+
 
 class Telemetry:
     enabled = True

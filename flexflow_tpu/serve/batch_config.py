@@ -83,6 +83,37 @@ class BatchConfig:
             seq_lens=seq_lens,
         )
 
+    def join_row(self, dst, tok, slot, pos, seq_len, num_tokens,
+                 active=True) -> "BatchConfig":
+        """Masked slot activation: merge ONE staged arrival into a running
+        scan's batch, on device.
+
+        A multi-step decode scan advances its BatchConfig entirely on
+        device, so an arrival admitted mid-stretch cannot be spliced in by
+        rebuilding the batch on host (that would force a sync).  Instead
+        the host prefills the prompt asynchronously, then activates flat
+        row ``dst`` for slot ``slot`` with the prefill's produced token
+        ``tok`` at position ``pos`` (= prompt length): the next scan
+        segment picks the row up exactly as if it had been in the batch
+        from the start.  ``active=False`` installs the row pre-frozen
+        (``request_index=-1``) — used when the prefill token already
+        terminated the request (EOS), so the scan never decodes past it.
+        All operands may be traced scalars; shapes are unchanged, so the
+        consuming scan's compiled program is reused as-is.
+        """
+        slot_i = jnp.asarray(slot, jnp.int32)
+        return BatchConfig(
+            tokens=self.tokens.at[dst].set(jnp.asarray(tok, jnp.int32)),
+            request_index=self.request_index.at[dst].set(
+                jnp.where(jnp.asarray(active), slot_i,
+                          jnp.int32(-1))),
+            token_position=self.token_position.at[dst].set(
+                jnp.asarray(pos, jnp.int32)),
+            num_tokens=jnp.asarray(num_tokens, jnp.int32),
+            seq_lens=self.seq_lens.at[slot_i].set(
+                jnp.asarray(seq_len, jnp.int32)),
+        )
+
     def split_microbatches(self, n_micro: int) -> list:
         """Split the flat token batch into ``n_micro`` contiguous ranges —
         the decode-time micro-batches pipeline-parallel serving interleaves

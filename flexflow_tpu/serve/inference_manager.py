@@ -29,6 +29,15 @@ from .kv_allocator import (  # noqa: F401 — re-exported for compat
 )
 from .ops import IncMultiHeadSelfAttention
 
+# Per-slot exit codes a decode scan carries in its state and returns with
+# the stretch's single readback (devices decide WHY a row stopped; the
+# host only reads the verdict).  Shared by InferenceManager.decode_scan*,
+# the pipeline-parallel manager, and SpecDecodeScan.
+EXIT_NOT_IN_BATCH = -1  # padding / row frozen before this scan began
+EXIT_RUNNING = 0        # budget left and no EOS: resume next segment
+EXIT_EOS = 1            # emitted the stop token mid-scan (frozen since)
+EXIT_BUDGET = 2         # consumed its max_new_tokens budget in this scan
+
 
 def tensor_parallel_strategy(
     graph, tp_axes: Tuple[str, ...] = ("tp",), mesh=None
@@ -449,6 +458,10 @@ class InferenceManager:
         self._pscan = jax.jit(self._prefill_scan_impl, donate_argnums=(1,),
                               static_argnames=("overlap",),
                               compiler_options=opts)
+        # mid-stretch slot join (on-device continuous batching): a tiny
+        # program that activates one batch row between scan segments
+        self._join = jax.jit(self._join_impl, static_argnames=("eos",),
+                             compiler_options=opts)
 
     @property
     def gate_lm_head(self) -> bool:
@@ -619,7 +632,7 @@ class InferenceManager:
         return result
 
     # ------------------------------------------------------------------
-    def _decode_scan_impl(self, params, state, bc, sample, pages,
+    def _decode_scan_impl(self, params, state, bc, sample, pages, allowed,
                           n_steps: int, eos: Optional[int]):
         """n_steps pure-decode steps as ONE on-device ``lax.scan``.
 
@@ -633,9 +646,33 @@ class InferenceManager:
         ``eos`` (static): slots that emit it are FROZEN for the rest of the
         scan — their request_index flips to -1, so later steps write their
         KV to the scratch row and their emissions are masked out of ``live``.
+
+        ``allowed`` (i32[max_tokens] or None): per-flat-row remaining token
+        budgets — the device-side ``max_new_tokens`` exit.  A row is frozen
+        the same way once it has emitted ``allowed[row]`` tokens, so a
+        chained stretch can run rows of UNEQUAL remaining budgets in one
+        scan without overshooting any of them.  Per-row exit codes
+        (``EXIT_*``) come back with the results: what ended each row —
+        still running, EOS, or budget — readable in the stretch's single
+        readback, so the host reaps lifecycle outcomes without re-deriving
+        them from the token stream.
         """
+        present = bc.request_index >= 0
+        alive0 = present
+        if allowed is not None:
+            alive0 = alive0 & (allowed > 0)
+            # entry freeze: a row that arrives with no budget must not
+            # write KV even on step 0 (its writes go to the scratch row)
+            bc = BatchConfig(
+                tokens=bc.tokens,
+                request_index=jnp.where(alive0, bc.request_index, -1),
+                token_position=bc.token_position,
+                num_tokens=bc.num_tokens,
+                seq_lens=bc.seq_lens,
+            )
+
         def body(carry, i):
-            state, bc, alive = carry
+            state, bc, alive, eos_hit = carry
             stp = None
             if sample is not None:
                 if len(sample) > 3:
@@ -654,9 +691,13 @@ class InferenceManager:
             toks = result.token_ids
             live = alive  # emission validity for THIS step
             if eos is not None:
-                alive = alive & (toks != eos)
+                hit = live & (toks == eos)
+                eos_hit = eos_hit | hit
+                alive = alive & ~hit
+            if allowed is not None:
+                alive = alive & (i + 1 < allowed)
             nxt = bc.advance(toks)
-            if eos is not None:
+            if eos is not None or allowed is not None:
                 nxt = BatchConfig(
                     tokens=nxt.tokens,
                     request_index=jnp.where(alive, nxt.request_index, -1),
@@ -664,23 +705,28 @@ class InferenceManager:
                     num_tokens=nxt.num_tokens,
                     seq_lens=nxt.seq_lens,
                 )
-            return (state, nxt, alive), (toks, live)
+            return (state, nxt, alive, eos_hit), (toks, live)
 
-        alive0 = bc.request_index >= 0
-        (state, bc, _), (tokens, live) = jax.lax.scan(
-            body, (state, bc, alive0), jnp.arange(n_steps)
+        eos_hit0 = jnp.zeros_like(alive0)
+        (state, bc, alive_end, eos_hit), (tokens, live) = jax.lax.scan(
+            body, (state, bc, alive0, eos_hit0), jnp.arange(n_steps)
         )
-        return tokens, live, state, bc
+        ecode = jnp.where(
+            ~present, EXIT_NOT_IN_BATCH,
+            jnp.where(eos_hit, EXIT_EOS,
+                      jnp.where(alive_end, EXIT_RUNNING, EXIT_BUDGET)),
+        ).astype(jnp.int32)
+        return tokens, live, ecode, state, bc
 
-    def decode_scan(self, bc, n_steps: int, eos: Optional[int] = None,
-                    sample=None):
-        """Run ``n_steps`` decode steps on device.
+    def _decode_scan_guards(self, n_steps: int, max_position=None,
+                            bc=None) -> None:
+        """Shared pre-dispatch validation for the scan paths.
 
-        Returns ``(tokens, live, bc)``: i32[n_steps, T] token ids,
-        bool[n_steps, T] emission validity (False once a slot passed its
-        ``eos``), and the advanced BatchConfig to resume from.
-        """
-        assert self.params is not None, "call init_operators_inference() first"
+        ``max_position``: the highest ``token_position`` in the batch as
+        HOST bookkeeping (the chained path always knows it — reading it
+        off a device-resident ``bc`` would force the mid-stretch sync the
+        whole design removes).  Falls back to reading ``bc`` when the
+        caller has no host-side count (external hand-built batches)."""
         import numpy as np
 
         from .ops import DUS_MAX_TOKENS
@@ -698,13 +744,26 @@ class InferenceManager:
                 "max_tokens_per_batch for scanned decoding",
                 stacklevel=2,
             )
-        last = int(np.max(np.asarray(bc.token_position))) + n_steps
+        if max_position is None:
+            max_position = int(np.max(np.asarray(bc.token_position)))
+        last = int(max_position) + n_steps
         if last > self.max_seq_len:
             raise ValueError(
                 f"decode_scan would reach position {last} > max_seq_len "
                 f"{self.max_seq_len}; cache writes past the end clamp to the "
                 "last slot and silently corrupt it"
             )
+
+    def decode_scan(self, bc, n_steps: int, eos: Optional[int] = None,
+                    sample=None):
+        """Run ``n_steps`` decode steps on device.
+
+        Returns ``(tokens, live, bc)``: i32[n_steps, T] token ids,
+        bool[n_steps, T] emission validity (False once a slot passed its
+        ``eos``), and the advanced BatchConfig to resume from.
+        """
+        assert self.params is not None, "call init_operators_inference() first"
+        self._decode_scan_guards(n_steps, bc=bc)
         if self.fault_injector is not None:
             self.fault_injector.maybe_fail("decode_scan")
         prof = self.profiler
@@ -713,13 +772,78 @@ class InferenceManager:
         with self.telemetry.span("decode_scan_dispatch", cat="dispatch",
                                  track="dispatch",
                                  n_steps=n_steps), prof.phase("dispatch"):
-            tokens, live, self.state, bc = self._scan(
+            tokens, live, _, self.state, bc = self._scan(
                 self.params, self.state, bc, sample, self._page_view(),
-                n_steps=n_steps, eos=eos
+                None, n_steps=n_steps, eos=eos
             )
         if self.telemetry.enabled:
             self.telemetry.metrics.counter("decode_scan_steps").inc(n_steps)
         return tokens, live, bc
+
+    def decode_scan_async(self, bc, n_steps: int, eos: Optional[int] = None,
+                          sample=None, allowed=None,
+                          max_position: Optional[int] = None):
+        """One chained-stretch segment: ``n_steps`` decode steps with NO
+        readback and NO host-side read of ``bc``.
+
+        The on-device continuous-batching path (request_manager's chained
+        ``_decode_stretch``): segments dispatch back-to-back, joins splice
+        arrivals in between them (``join_slot``), and the host materializes
+        everything in ONE sync at stretch end.  ``allowed`` is the
+        per-flat-row remaining-token budget (i32[max_tokens]); rows freeze
+        on device when it runs out, so heterogeneous budgets share one
+        scan.  ``max_position`` is the caller's host bookkeeping of the
+        batch's highest token position (required: this path must not sync
+        to validate).  Returns LAZY device values
+        ``(tokens, live, exit_codes, bc)``.
+        """
+        assert self.params is not None, "call init_operators_inference() first"
+        assert max_position is not None, \
+            "decode_scan_async requires host-tracked max_position"
+        self._decode_scan_guards(n_steps, max_position=max_position)
+        if self.fault_injector is not None:
+            self.fault_injector.maybe_fail("decode_scan")
+        prof = self.profiler
+        if prof.enabled:
+            prof.count("dispatches")
+        with self.telemetry.span("decode_scan_dispatch", cat="dispatch",
+                                 track="dispatch",
+                                 n_steps=n_steps), prof.phase("dispatch"):
+            tokens, live, ecode, self.state, bc = self._scan(
+                self.params, self.state, bc, sample, self._page_view(),
+                allowed, n_steps=n_steps, eos=eos
+            )
+        if self.telemetry.enabled:
+            self.telemetry.metrics.counter("decode_scan_steps").inc(n_steps)
+        return tokens, live, ecode, bc
+
+    def _join_impl(self, bc, tok_src, src_idx, dst, slot, pos, seq_len,
+                   num_tokens, eos: Optional[int]):
+        tok = tok_src[src_idx]
+        active = True if eos is None else tok != eos
+        return bc.join_row(dst, tok, slot, pos, seq_len, num_tokens,
+                           active=active)
+
+    def join_slot(self, bc, tok_src, src_idx, dst, slot, pos, seq_len,
+                  num_tokens, eos: Optional[int] = None):
+        """Splice one staged arrival into a running stretch's batch.
+
+        ``tok_src``: the arrival's final prefill-chunk result tokens (a
+        DEVICE array — reading it would sync); ``src_idx``: where its next
+        token sits in that array; ``dst``: the flat batch row the request
+        occupies from now on; ``slot``/``pos``/``seq_len``/``num_tokens``:
+        host bookkeeping of the joined batch.  One tiny jitted program
+        (fixed avals — compiles once, polled by the recompile guard); the
+        dispatched chain stays fully async.
+        """
+        prof = self.profiler
+        if prof.enabled:
+            prof.count("dispatches")
+        with prof.phase("dispatch"):
+            return self._join(
+                bc, tok_src, jnp.int32(src_idx), jnp.int32(dst),
+                jnp.int32(slot), jnp.int32(pos), jnp.int32(seq_len),
+                jnp.int32(num_tokens), eos=eos)
 
     # ------------------------------------------------------------------
     def _project_chunk0(self, params, bc):

@@ -50,6 +50,10 @@ from ..obs.profiler import NULL_PROFILER
 from ..obs.telemetry import NULL_TELEMETRY
 from .batch_config import BatchConfig, InferenceResult
 from .inference_manager import (
+    EXIT_BUDGET,
+    EXIT_EOS,
+    EXIT_NOT_IN_BATCH,
+    EXIT_RUNNING,
     mark_gated_lm_head,
     pick_prefill_tile,
     register_serve_capacities,
@@ -386,6 +390,13 @@ class PipelinedInferenceManager:
             self._advance_impl, static_argnames=("eos",),
             compiler_options=collective_safe_compiler_options(last_mesh),
         )
+        # mid-stretch slot join (on-device continuous batching): a tiny
+        # program on the last stage's mesh that activates one batch row
+        # between chained scan segments
+        self._join = jax.jit(
+            self._join_impl, static_argnames=("eos",),
+            compiler_options=collective_safe_compiler_options(last_mesh),
+        )
 
     # ------------------------------------------------------------------
     @property
@@ -571,9 +582,6 @@ class PipelinedInferenceManager:
                           stage=s, mb=mb), prof.phase(f"stage{s}"):
                 if fi is not None:
                     fi.maybe_fail(f"stage{s}_dispatch")
-                bc_s = jax.device_put(bc, stage.replicated)
-                pg_s = (jax.device_put(pages, stage.replicated)
-                        if pages is not None else None)
                 if s > 0:
                     if fi is not None:
                         fi.maybe_fail(f"stage{s}_hop")
@@ -582,8 +590,16 @@ class PipelinedInferenceManager:
                     if tel.enabled:
                         tel.metrics.counter("pp_hops").inc()
                     with prof.phase("hop"):
-                        xs = tuple(jax.device_put(x, stage.replicated)
-                                   for x in xs)
+                        # the whole hop ships as ONE batched transfer —
+                        # batch descriptor, page table and boundary
+                        # activations in a single pytree device_put (one
+                        # async transfer launch) instead of a host call
+                        # per operand
+                        bc_s, pg_s, xs = jax.device_put(
+                            (bc, pages, xs), stage.replicated)
+                else:
+                    bc_s, pg_s = jax.device_put((bc, pages),
+                                                stage.replicated)
                 if prof.enabled:
                     prof.count("dispatches")
                 if s < n - 1:
@@ -648,15 +664,26 @@ class PipelinedInferenceManager:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _advance_impl(bc, toks, alive, eos):
-        """The decode-scan body's advance/EOS logic (see
+    def _advance_impl(bc, toks, alive, eos_hit, step_i, allowed, eos):
+        """The decode-scan body's advance/lifecycle logic (see
         InferenceManager._decode_scan_impl), jitted on the last stage's
-        mesh so multi-step decode never syncs the host."""
+        mesh so multi-step decode never syncs the host.
+
+        ``eos_hit`` carries which rows exited via EOS (vs exhausting
+        their ``allowed`` budget) for the per-row exit codes; ``allowed``
+        (i32 per flat row, or None) freezes each row after ITS budget —
+        rows of unequal remaining budgets ride one chained stretch.
+        ``step_i`` is the current step's index within the segment (device
+        scalar, so one compiled program serves every step)."""
         live = alive
         if eos is not None:
-            alive = alive & (toks != eos)
+            hit = alive & (toks == eos)
+            eos_hit = eos_hit | hit
+            alive = alive & ~hit
+        if allowed is not None:
+            alive = alive & (step_i + 1 < allowed)
         nxt = bc.advance(toks)
-        if eos is not None:
+        if eos is not None or allowed is not None:
             nxt = BatchConfig(
                 tokens=nxt.tokens,
                 request_index=jnp.where(alive, nxt.request_index, -1),
@@ -664,7 +691,33 @@ class PipelinedInferenceManager:
                 num_tokens=nxt.num_tokens,
                 seq_lens=nxt.seq_lens,
             )
-        return nxt, alive, live
+        return nxt, alive, eos_hit, live
+
+    @staticmethod
+    def _join_impl(bc, tok_src, src_idx, dst, slot, pos, seq_len,
+                   num_tokens, eos):
+        """Activate one batch row from a staged arrival's held prefill
+        result (see InferenceManager._join_impl): the row joins pre-frozen
+        when the held token already IS the terminator."""
+        tok = tok_src[src_idx]
+        active = True if eos is None else tok != eos
+        return bc.join_row(dst, tok, slot, pos, seq_len, num_tokens,
+                           active=active)
+
+    def join_slot(self, bc, tok_src, src_idx, dst, slot, pos, seq_len,
+                  num_tokens, eos=None):
+        """Splice a mid-stretch arrival into the running (device-resident)
+        batch — same contract as InferenceManager.join_slot; the join
+        program runs on the last stage's mesh, where the chained scan's
+        BatchConfig lives."""
+        prof = self.profiler
+        if prof.enabled:
+            prof.count("dispatches")
+        with prof.phase("dispatch"):
+            return self._join(
+                bc, tok_src, jnp.int32(src_idx), jnp.int32(dst),
+                jnp.int32(slot), jnp.int32(pos), jnp.int32(seq_len),
+                jnp.int32(num_tokens), eos=eos)
 
     def decode_scan(self, bc, n_steps: int, eos: Optional[int] = None,
                     sample=None):
@@ -686,6 +739,7 @@ class PipelinedInferenceManager:
         rep = self.stages[-1].replicated
         mbs = [jax.device_put(mb, rep) for mb in mbs]
         alive = [mb.request_index >= 0 for mb in mbs]
+        eos_hit = [jnp.zeros_like(a) for a in alive]
         toks = [[None] * m for _ in range(n_steps)]
         lives = [[None] * m for _ in range(n_steps)]
         tel = self.telemetry
@@ -711,8 +765,9 @@ class PipelinedInferenceManager:
                             key, t, p = sample
                             smp = (jax.random.fold_in(key, i * m + j), t, p)
                     res = self._dispatch(mbs[j], smp, mb=j, pages=pv)
-                    mbs[j], alive[j], live = self._advance(
-                        mbs[j], res.token_ids, alive[j], eos=eos)
+                    mbs[j], alive[j], eos_hit[j], live = self._advance(
+                        mbs[j], res.token_ids, alive[j], eos_hit[j],
+                        jnp.int32(i), None, eos=eos)
                     toks[i][j] = res.token_ids
                     lives[i][j] = live
         tokens = np.stack([
@@ -723,6 +778,98 @@ class PipelinedInferenceManager:
         ])
         bc_out = self._merge_bcs(mbs)
         return tokens, live_np, bc_out
+
+    def decode_scan_async(self, bc, n_steps: int, eos: Optional[int] = None,
+                          sample=None, allowed=None, max_position=None):
+        """``n_steps`` pure-decode macro-steps with NOTHING materialized:
+        returns LAZY device values — ``(tokens [n, max_tokens], live
+        masks, per-row exit codes, advanced BatchConfig)`` — so a chained
+        stretch dispatches segment after segment (pp hops included,
+        device-to-device) and reads everything back once at stretch end.
+
+        ``allowed`` (i32 per flat row, or None) is each row's step budget
+        for THIS segment: the advance freezes a row after its budget, and
+        the exit codes report EXIT_EOS vs EXIT_BUDGET vs EXIT_RUNNING per
+        row (EXIT_NOT_IN_BATCH for pad/frozen-at-entry rows).
+
+        ``max_position`` is REQUIRED: the host-known largest starting
+        token position across rows.  The legacy ``decode_scan`` reads it
+        from the batch with ``np.max`` — a host sync the chained path
+        cannot afford on a device-resident mid-stretch BatchConfig.
+        """
+        assert self.stages[0].params is not None, \
+            "call init_operators_inference() first"
+        assert max_position is not None, \
+            "decode_scan_async needs the host-tracked max_position"
+        last = max_position + n_steps
+        if last > self.max_seq_len:
+            raise ValueError(
+                f"decode_scan would reach position {last} > max_seq_len "
+                f"{self.max_seq_len}")
+        fi = self.fault_injector
+        if fi is not None:
+            fi.maybe_fail("decode_scan")
+        mbs = self._microbatches(bc)
+        m = len(mbs)
+        rep = self.stages[-1].replicated
+        mbs = [jax.device_put(mb, rep) for mb in mbs]
+        k = self.max_tokens // m
+        alw = [None] * m
+        if allowed is not None:
+            alw_full = jax.device_put(jnp.asarray(allowed, jnp.int32), rep)
+            alw = [alw_full[j * k: (j + 1) * k] for j in range(m)]
+        # present BEFORE the entry freeze: a present row whose budget is
+        # already 0 exits as EXIT_BUDGET, not EXIT_NOT_IN_BATCH
+        present0 = [mb.request_index >= 0 for mb in mbs]
+        if allowed is not None:
+            # entry freeze: a present row with no budget must not write
+            # its step-0 KV (the frozen row's writes land in scratch)
+            mbs = [BatchConfig(
+                tokens=mb.tokens,
+                request_index=jnp.where(a > 0, mb.request_index, -1),
+                token_position=mb.token_position,
+                num_tokens=mb.num_tokens,
+                seq_lens=mb.seq_lens,
+            ) for mb, a in zip(mbs, alw)]
+        alive = [mb.request_index >= 0 for mb in mbs]
+        eos_hit = [jnp.zeros_like(a) for a in alive]
+        toks = [[None] * m for _ in range(n_steps)]
+        lives = [[None] * m for _ in range(n_steps)]
+        tel = self.telemetry
+        if tel.enabled:
+            tel.metrics.gauge("pp_bubble_frac").set(
+                max(0, self.pp - m) / self.pp)
+        pv = self._page_view()
+        for i in range(n_steps):
+            with tel.span("pp_decode_macro_step", cat="pp", track="pp",
+                          step=i, n_micro=m):
+                for j in range(m):
+                    smp = None
+                    if sample is not None:
+                        if len(sample) > 3:
+                            key, t, p, folds = sample
+                            f = folds[j * k: (j + 1) * k]
+                            smp = (key, t, p,
+                                   f + jnp.array([0, i], jnp.int32))
+                        else:
+                            key, t, p = sample
+                            smp = (jax.random.fold_in(key, i * m + j), t, p)
+                    res = self._dispatch(mbs[j], smp, mb=j, pages=pv)
+                    mbs[j], alive[j], eos_hit[j], live = self._advance(
+                        mbs[j], res.token_ids, alive[j], eos_hit[j],
+                        jnp.int32(i), alw[j], eos=eos)
+                    toks[i][j] = res.token_ids
+                    lives[i][j] = live
+        cat = (lambda xs: xs[0]) if m == 1 else jnp.concatenate
+        tokens = jnp.stack([cat(row) for row in toks])
+        live_out = jnp.stack([cat(row) for row in lives])
+        ecode = cat([
+            jnp.where(~present0[j], EXIT_NOT_IN_BATCH,
+                      jnp.where(eos_hit[j], EXIT_EOS,
+                                jnp.where(alive[j], EXIT_RUNNING,
+                                          EXIT_BUDGET))).astype(jnp.int32)
+            for j in range(m)])
+        return tokens, live_out, ecode, self._merge_bcs(mbs)
 
     @staticmethod
     def _merge_bcs(mbs: Sequence[BatchConfig]) -> BatchConfig:

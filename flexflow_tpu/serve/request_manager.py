@@ -21,6 +21,7 @@ import numpy as np
 
 from ..obs.telemetry import telemetry_or_null
 from .batch_config import BatchConfig, PrefillBatchConfig
+from .inference_manager import EXIT_NOT_IN_BATCH
 from .resilience import ResilienceConfig, TransientServeError
 
 
@@ -1008,8 +1009,18 @@ class RequestManager:
         ``[(flat_token_index, rid)]`` — the token slots whose model output is
         the next token of that request (last prefill token, or the decode
         token).  Mirrors ``RequestManager::prepare_next_batch``.
+
+        Phase attribution (StepProfiler): admission/slot-fill runs under
+        ``host_admit``, batch assembly under ``host_prepare`` — separate
+        accumulators, so the time budget shows scheduling cost apart from
+        batch-build cost.
         """
-        self._admit()
+        with self.profiler.phase("host_admit"):
+            self._admit()
+        with self.profiler.phase("host_prepare"):
+            return self._build_next_batch()
+
+    def _build_next_batch(self) -> Tuple[BatchConfig, List[Tuple[int, int]]]:
         tokens: List[int] = []
         req_idx: List[int] = []
         positions: List[int] = []
@@ -1251,16 +1262,34 @@ class RequestManager:
         (so no slot overshoots max_new_tokens) and by cache headroom.
         """
         active = self._active()
-        if (self.pending or not active
+        if (not active
                 or any(r.status is not RequestStatus.DECODING
                        for r in active)):
             return 0
+        if self.pending:
+            # pending work blocks a stretch ONLY when the per-tick path
+            # could actually act on it right now — a free slot to fill, or
+            # a preemption that would fire.  Otherwise (all slots busy, no
+            # victim) the queue is waiting regardless, and the chained
+            # stretch path admits mid-stretch joiners itself the moment a
+            # slot frees, so the stretch proceeds
+            eligible = [rid for rid in self.pending
+                        if not self._held(self.requests[rid])]
+            chained = (self.chain_segments
+                       and hasattr(self.im, "decode_scan_async")
+                       and not self.admission_closed)
+            if eligible and (not chained
+                             or any(s is None for s in self.slots)
+                             or self._preempt_would_fire()):
+                return 0
         n = min(r.max_new_tokens - len(r.generated) for r in active)
         n = min(n, self.scan_chunk,
                 self.im.max_seq_len - max(r.seq_len for r in active) + 1)
         # armed deadlines or pending cancels bound the stretch: lifecycle
         # reaping happens at host step boundaries, so an uncapped scan
-        # would overshoot a deadline by up to scan_chunk device steps
+        # would overshoot a deadline by up to scan_chunk device steps.
+        # (Under the chained path this bounds SEGMENTS, not the stretch —
+        # the chain clock-checks between dispatches; see _decode_stretch.)
         if any(r.deadline_s is not None or r.cancel_requested
                for r in active):
             n = min(n, self.lifecycle_quantum)
@@ -1271,7 +1300,39 @@ class RequestManager:
             n = 1 << (n.bit_length() - 1)
         return n
 
+    def _preempt_would_fire(self) -> bool:
+        """Would _try_preempt evict someone for the head of the queue?
+        Mirrors its victim scan without acting — the chained stretch gate
+        must fall back to the per-tick path whenever preemption could
+        admit pending work (preempting a row the device is mid-scan on
+        would corrupt its cache)."""
+        if not self.res.preemption or not self.pending:
+            return False
+        eligible = [rid for rid in self.pending
+                    if not self._held(self.requests[rid])]
+        if not eligible:
+            return False
+        head_pri = max(self.requests[rid].priority for rid in eligible)
+        return any(r.status is RequestStatus.DECODING
+                   and r.priority < head_pri
+                   and r.preemptions < self.res.max_preemptions
+                   for r in self._active())
+
     scan_chunk = 32  # sync-amortization window for the decode scan
+    # chain decode-scan segments back-to-back (no readback in between) up
+    # to scan_chunk total steps, admitting arrivals into the RUNNING
+    # batch at segment boundaries (on-device continuous batching).  Off:
+    # the legacy one-dispatch-per-stretch path (the bit-identity
+    # comparator tests/test_host_tick.py pins against)
+    chain_segments = True
+    # serve_with_arrivals hooks for the chained path: pump registers
+    # newly-due arrivals at segment boundaries; stamp records
+    # prefill_start_s for mid-stretch joiners
+    _arrival_pump = None
+    _join_stamp = None
+    # rid -> device exit code of the last chained stretch (EXIT_* in
+    # inference_manager.py); rebound per stretch, never mutated in place
+    last_exit_codes: Dict[int, int] = {}
     # mixed decode+prefill steps whose tiled budget rounds to 0 before the
     # starved request falls back to an unaligned flat-path take (bounds the
     # TTFT inflation at ~limit decode steps; see prepare_next_batch)
@@ -1296,7 +1357,8 @@ class RequestManager:
         ONE host sync at the end, vs a dispatch per chunk (+ a ~100ms tunnel
         sync per request boundary) on the per-step path.
         """
-        self._admit()
+        with self.profiler.phase("host_admit"):
+            self._admit()
         active = self._active()
         tile = getattr(self.im, "prefill_tile", 1)
         return (
@@ -1422,7 +1484,347 @@ class RequestManager:
         self.scan_runs += 1
 
     def _decode_stretch(self, n: int) -> None:
-        """Run n decode steps on device with one host sync (decode_scan)."""
+        """Run one decode stretch with ONE host sync.
+
+        With :attr:`chain_segments` on (and an ``im`` exposing the async
+        scan path) the stretch is a CHAIN of back-to-back
+        ``decode_scan_async`` segments — dispatched with no readback
+        between them — that keeps running up to ``scan_chunk`` total
+        steps while any row has budget left:
+
+        * rows of UNEQUAL remaining budgets ride one stretch (the device
+          freezes each row at ITS budget via the ``allowed`` mask and
+          reports a per-row exit code; the host no longer stops the whole
+          scan at the smallest budget);
+        * armed deadlines/cancels bound SEGMENTS (the host clock-checks
+          between dispatches, same ``lifecycle_quantum`` granularity)
+          instead of terminating the stretch;
+        * arrivals landing mid-stretch JOIN the running batch at the next
+          segment boundary — async flat prefill of the prompt, then
+          ``join_slot`` splices the held first token into the batch — so
+          pending work no longer degenerates serving to one dispatch per
+          token.
+
+        Everything materializes in ONE readback at stretch end (tokens,
+        emission masks, exit codes), then commits in dispatch order —
+        bit-identical to the per-tick loop by construction (same sample
+        folds, same masks).
+        """
+        if not (self.chain_segments
+                and hasattr(self.im, "decode_scan_async")):
+            return self._decode_stretch_single(n)
+        im = self.im
+        prof = self.profiler
+        eos = self.gen.eos_token_id if self.gen.stop_on_eos else None
+        # whole first-segment write spans up front, BEFORE building the
+        # batch (page-pressure preemption inside the prepare can evict a
+        # victim, which must drop out of the batch)
+        self._kv_prepare([(r.rid, r.seq_len - 1, r.seq_len - 1 + n)
+                          for r in self._active()])
+        active = [r for r in self._active()
+                  if r.status is RequestStatus.DECODING]
+        if not active:
+            return
+        rows: List[Tuple[Request, int]] = []   # (req, flat row) in order
+        sched: Dict[int, int] = {}    # rid -> tokens produced this stretch
+        dev_seq: Dict[int, int] = {}  # rid -> device-side cache depth
+        with prof.phase("host_prepare"):
+            tokens, reqi, pos = [], [], []
+            for req in active:
+                tokens.append(req.generated[-1])
+                reqi.append(req.slot)
+                pos.append(req.seq_len - 1)
+                rows.append((req, len(rows)))
+                sched[req.rid] = 0
+                dev_seq[req.rid] = req.seq_len
+            seq_lens = np.zeros(im.max_requests, np.int32)
+            for req in active:
+                seq_lens[req.slot] = req.seq_len
+            bc = BatchConfig.build(
+                tokens, reqi, pos, seq_lens,
+                max_tokens=im.max_tokens, max_requests=im.max_requests)
+
+        def remaining(req):
+            return req.max_new_tokens - len(req.generated) - sched[req.rid]
+
+        # chronological commit log: ("scan", seg, [(flat, rid)], toks,
+        # live, ecode) per dispatched segment, ("join", req, token_ids,
+        # src_idx) per spliced arrival — all values LAZY until the single
+        # readback below
+        commits: List[Tuple] = []
+        total = 0
+        n_segments = 0
+        n_joins = 0
+        seg = n
+        while True:
+            ks: Dict[int, int] = {}
+            allowed = np.zeros(im.max_tokens, np.int32)
+            pts = []
+            for req, flat in rows:
+                k = max(min(seg, remaining(req)), 0)
+                ks[req.rid] = k
+                # the emission budget is the row's FULL remaining, not
+                # the segment cap: a row that outlives this segment must
+                # end it alive so its exit code reads RUNNING, not BUDGET
+                allowed[flat] = max(remaining(req), 0)
+                pts.append((flat, req.rid, sched[req.rid]))
+            if prof.enabled:
+                # k_i decode steps per row: each streams the weights and
+                # reads the growing causally-live prefix
+                prof.account(
+                    prof.card_for(im),
+                    [(req.rid, ks[req.rid],
+                      ks[req.rid] * dev_seq[req.rid]
+                      + ks[req.rid] * (ks[req.rid] - 1) // 2)
+                     for req, _ in rows if ks[req.rid] > 0],
+                    passes=seg)
+            # sample folds advance past the stretch's UNCOMMITTED tokens:
+            # row i's next key is (rid_i, len(generated_i) + sched_i)
+            smp = self._sample_for(pts, im.max_tokens)
+            max_pos = max(dev_seq[req.rid] - 1 + ks[req.rid]
+                          for req, _ in rows) - seg
+            this_seg = seg
+            out = self._guarded(
+                "decode_scan",
+                lambda: im.decode_scan_async(
+                    bc, this_seg, eos=eos, sample=smp,
+                    allowed=allowed, max_position=max_pos))
+            if out is None:
+                # the whole stretch's emissions were in flight and nothing
+                # was committed: the requeue recompute regenerates every
+                # token deterministically, earlier segments included
+                self.scan_runs += 1
+                return
+            toks, live, ecode, bc = out
+            commits.append(("scan", this_seg,
+                            [(flat, req.rid) for req, flat in rows],
+                            toks, live, ecode))
+            for req, _ in rows:
+                sched[req.rid] += ks[req.rid]
+                dev_seq[req.rid] += ks[req.rid]
+            total += this_seg
+            n_segments += 1
+
+            # ---- segment boundary: extend, join, or stop --------------
+            reqs = [req for req, _ in rows]
+            if any(r.cancel_requested for r in reqs):
+                break                      # reap at the tick boundary
+            if any(r.slot < 0 or self.slots[r.slot] != r.rid
+                   for r in reqs):
+                break   # a clock-callback preempted/terminated a row
+            if self._arrival_pump is not None:
+                with prof.phase("host_admit"):
+                    self._arrival_pump()   # register newly-due arrivals
+            rem_cap = self.scan_chunk - total
+            if rem_cap < 2:
+                break
+            if (self.pending and not self.admission_closed
+                    and len(rows) < im.max_tokens
+                    and any(s is None for s in self.slots)
+                    and any(not self._held(self.requests[rid])
+                            for rid in self.pending)):
+                bc = self._stretch_join(bc, rows, sched, dev_seq,
+                                        commits, eos)
+                n_joins = sum(1 for c in commits if c[0] == "join")
+            armed = [r.deadline_s for r, _ in rows
+                     if r.deadline_s is not None]
+            if armed and self.clock() >= min(armed):
+                break                      # reap at the tick boundary
+            rem = [remaining(req) for req, _ in rows]
+            rem_max = max(rem) if rem else 0
+            if rem_max < 2:
+                break   # a 1-step trailer rides the next tick's mixed
+                        # step (no single-step scan compile class)
+            seg = min(rem_cap, rem_max)
+            if armed:
+                seg = min(seg, self.lifecycle_quantum)
+            seg = 1 << (seg.bit_length() - 1)
+            if seg < 2:
+                break
+            spans = [(req.rid, dev_seq[req.rid] - 1,
+                      dev_seq[req.rid] - 1 + min(seg, remaining(req)))
+                     for req, _ in rows if remaining(req) > 0]
+            if not self._kv_prepare_nopreempt(spans):
+                break   # page pressure resolves on the per-tick path
+
+        # ---- single readback + chronological commit -------------------
+        with prof.phase("readback"):
+            ready = []
+            for item in commits:
+                if item[0] == "scan":
+                    _, sg, pts2, toks, live, ecode = item
+                    ready.append(("scan", sg, pts2, np.asarray(toks),
+                                  np.asarray(live), np.asarray(ecode)))
+                else:
+                    _, req, token_ids, src = item
+                    ready.append(("join", req,
+                                  int(np.asarray(token_ids)[src])))
+        prof.host_sync()
+        codes: Dict[int, int] = {}
+        for item in ready:
+            if item[0] == "join":
+                _, req, tok = item
+                if req.status not in (RequestStatus.PREFILLING,
+                                      RequestStatus.DECODING):
+                    continue   # left its slot before commit: emission is
+                               # dead, the readmission recomputes it
+                if req.status is RequestStatus.PREFILLING:
+                    req.status = RequestStatus.DECODING
+                self._append_token(req, tok)
+                self._maybe_finish(req)
+                continue
+            _, sg, pts2, toks, live, ecode = item
+            for s in range(sg):
+                for flat, rid in pts2:
+                    req = self.requests[rid]
+                    if (req.status is not RequestStatus.DECODING
+                            or not live[s, flat]):
+                        continue
+                    self._append_token(req, int(toks[s, flat]))
+                    self._maybe_finish(req)
+            for flat, rid in pts2:
+                c = int(ecode[flat])
+                if c != EXIT_NOT_IN_BATCH:
+                    codes[rid] = c   # the segment where the row ran last
+        self.last_exit_codes = codes
+        self.steps += total
+        self.scan_runs += 1
+        if prof.enabled:
+            prof.note(decode_quantum=n, stretch_steps=total,
+                      stretch_segments=n_segments,
+                      stretch_joins=n_joins)
+
+    def _stretch_join(self, bc, rows, sched, dev_seq, commits, eos):
+        """Admit pending arrivals INTO the running stretch (on-device
+        continuous batching): fill free slots, asynchronously prefill
+        each joiner's prompt (flat chunks, no readback), then splice its
+        held first token into the live batch via ``join_slot`` — the
+        device decodes it from the next segment on.  Page exhaustion or
+        dispatch failure un-joins the request back to the queue; the
+        per-tick path retries it with the full pressure machinery."""
+        im = self.im
+        with self.profiler.phase("host_admit"):
+            pre = {rid for rid in self.slots if rid is not None}
+            self._fill_slots()
+            newly = [rid for rid in self.slots
+                     if rid is not None and rid not in pre]
+        stamped = []
+        for rid in newly:
+            req = self.requests[rid]
+            if len(rows) >= im.max_tokens:
+                # no flat-row capacity left: the leftover stays slotted
+                # and prefills on the next tick's per-step path
+                continue
+            out = self._stretch_prefill(req, rows, dev_seq)
+            if out is None:
+                if (req.status is RequestStatus.PREFILLING
+                        and req.slot >= 0
+                        and self.slots[req.slot] == req.rid):
+                    self._unjoin(req)
+                continue
+            res, src = out
+            stamped.append(rid)
+            L = len(req.prefill_tokens)
+            commits.append(("join", req, res.token_ids, src))
+            if req.max_new_tokens - len(req.generated) <= 1:
+                # the held token is the whole remaining budget: nothing
+                # to decode — it completes at the stretch readback
+                continue
+            dst = len(rows)
+            bc = im.join_slot(bc, res.token_ids, src, dst, req.slot,
+                              L, L + 1, dst + 1, eos=eos)
+            rows.append((req, dst))
+            sched[req.rid] = 1
+            dev_seq[req.rid] = L + 1
+        if stamped:
+            if self._join_stamp is not None:
+                self._join_stamp(stamped)
+            if self.telemetry.enabled:
+                self.telemetry.metrics.counter("stretch_joins").inc(
+                    len(stamped))
+        return bc
+
+    def _stretch_prefill(self, req, rows, dev_seq):
+        """Asynchronously feed one joining request's whole prompt (flat
+        chunks, results left on device) and return ``(result, src_idx)``
+        of the final chunk — the joiner's first generated token, read
+        back only at the stretch's single readback.  None when the feed
+        could not run (page-pool exhaustion before dispatch, or a
+        dispatch failure after retries — the latter already requeued the
+        request via the retry guard)."""
+        im = self.im
+        feed = req.prefill_tokens
+        L = len(feed)
+        if not self._kv_prepare_nopreempt(
+                [(req.rid, req.prefill_offset, L)]):
+            return None
+        res = src = None
+        while req.prefill_offset < L:
+            start = req.prefill_offset
+            take = min(im.max_tokens, L - start)
+            done = start + take == L
+            with self.profiler.phase("host_prepare"):
+                # running rows' cache depths are their DEVICE depths (the
+                # chain is ahead of the committed host view); only the
+                # joiner's own entry is read by its feed
+                seq_lens = np.zeros(im.max_requests, np.int32)
+                for r2, _ in rows:
+                    seq_lens[r2.slot] = dev_seq[r2.rid]
+                seq_lens[req.slot] = start + take
+                bc2 = BatchConfig.build(
+                    list(feed[start: start + take]), [req.slot] * take,
+                    list(range(start, start + take)), seq_lens,
+                    max_tokens=im.max_tokens,
+                    max_requests=im.max_requests)
+            smp = (self._sample_for([(take - 1, req.rid)], im.max_tokens)
+                   if done else None)
+            self._prof_account([(req.rid, start, start + take)])
+            out = self._guarded(
+                "step", lambda b=bc2, s=smp: im.step(b, sample=s),
+                affected_fn=lambda: [req.rid])
+            if out is None:
+                return None
+            req.prefill_offset = start + take
+            res, src = out, take - 1
+        if res is None:
+            return None   # nothing left to feed (cannot happen: the
+                          # prefix cache keeps at least the last token)
+        return res, src
+
+    def _unjoin(self, req) -> None:
+        """Back a failed mid-stretch join out to the queue: release the
+        slot (and its pages) and requeue at the head — the per-tick
+        admission path re-admits it with preemption/page-pressure
+        handling the stretch must not run."""
+        self._release_slot(req)
+        req.prefill_offset = 0
+        req.status = (RequestStatus.PREEMPTED if req.preemptions
+                      else RequestStatus.PENDING)
+        self.pending.insert(0, req.rid)
+        self._pending_since.setdefault(req.rid, self.steps)
+
+    def _kv_prepare_nopreempt(self, spans, kv=None) -> bool:
+        """Page preparation for a mid-stretch dispatch: the batch rows
+        are live in a RUNNING chain, so pool pressure must NOT preempt
+        (evicting a row the device is still decoding would corrupt its
+        cache).  Returns False on exhaustion — the caller stops extending
+        the stretch (or skips the join) and the per-tick path resolves
+        the pressure with the full victim machinery."""
+        kv = kv if kv is not None else getattr(self.im, "kv", None)
+        if kv is None or not getattr(kv, "paged", False) or not spans:
+            return True
+        from .kv_paged import PagePoolExhausted
+        try:
+            for rid, lo, hi in spans:
+                kv.prepare_write(rid, lo, hi)
+        except PagePoolExhausted:
+            return False
+        return True
+
+    def _decode_stretch_single(self, n: int) -> None:
+        """The unchained stretch: n decode steps as ONE decode_scan
+        dispatch, one host sync (the ``chain_segments=False`` baseline
+        the continuous-batching bit-identity tests compare against)."""
         # the scan writes n positions per request with no host boundary in
         # between — map (and COW-resolve) the whole span up front, BEFORE
         # building the batch: page-pressure preemption inside the prepare
@@ -1483,6 +1885,9 @@ class RequestManager:
                 self._maybe_finish(req)
         self.steps += n
         self.scan_runs += 1
+        if prof.enabled:
+            prof.note(decode_quantum=n, stretch_steps=n,
+                      stretch_segments=1, stretch_joins=0)
 
     def _serve_tick(self) -> None:
         """One scheduling decision + dispatch of the incremental loop:
@@ -1501,8 +1906,9 @@ class RequestManager:
                 self._decode_stretch(n)
             return
         with tel.span("serve_step", cat="serve"):
-            with self.profiler.phase("host_prepare"):
-                bc, sample_points = self.prepare_next_batch()
+            # prepare_next_batch attributes its own host_admit /
+            # host_prepare phases
+            bc, sample_points = self.prepare_next_batch()
             base = bc if isinstance(bc, BatchConfig) else bc.base
             if int(np.asarray(base.num_tokens)) == 0:
                 # nothing slotted fed a token (admission closed during a
@@ -1766,11 +2172,13 @@ class RequestManager:
         SpecInferManager).  ``clock``: 0-arg seconds callable (injectable for
         hermetic tests; default ``time.perf_counter``); it also drives the
         deadline/TTL checks for the loop's duration.  ``quantum``: cap on
-        the on-device decode-scan stretch while arrivals are outstanding,
-        so a long scan can't defer admission unboundedly (TTFT protection;
-        the full ``scan_chunk`` window returns once every arrival is in) —
-        cancellations and deadlines land at the same step-boundary
-        granularity.
+        the on-device decode-scan stretch while arrivals are outstanding
+        — LEGACY-PATH ONLY (``chain_segments=False``): the chained
+        stretch admits arrivals into the RUNNING scan at segment
+        boundaries (on-device continuous batching, see
+        :meth:`_decode_stretch`), so pending arrivals no longer cap the
+        stretch at all; cancellations and deadlines still land at
+        segment-boundary granularity.
 
         Returns ``{rid: record}`` with ``arrival_s``, ``first_token_s``
         (host-visible TTFT stamp), ``finish_s``, ``prompt_len``,
@@ -1859,7 +2267,26 @@ class RequestManager:
                 pending, clock=clock, quantum=quantum,
                 _t0=t0, _records=records, _open=open_rids)
 
+        def stamp_joined(rids):
+            # mid-stretch joiners started (and usually finished) prefill
+            # INSIDE the tick: stamp prefill_start_s at join time, same
+            # step-boundary clock the per-tick starters path uses
+            now2 = clock() - t0
+            for rid in rids:
+                rec = records.get(rid)
+                if rec is not None and "prefill_start_s" not in rec:
+                    rec["prefill_start_s"] = now2
+                    if tel.enabled:
+                        tel.request_prefill_started(
+                            self.requests[rid].trace_id)
+
+        chained = (self.chain_segments
+                   and hasattr(self.im, "decode_scan_async"))
         try:
+            # the chained stretch pulls newly-due arrivals in at segment
+            # boundaries itself (and stamps joiners' records)
+            self._arrival_pump = admit_due if chained else None
+            self._join_stamp = stamp_joined if chained else None
             while pending or self.has_work():
                 now = admit_due()
                 self._check_lifecycle()
@@ -1876,7 +2303,11 @@ class RequestManager:
                         _time.sleep(min(1e-3, max(0.0,
                                                   pending[0][0] - now)))
                     continue
-                self.scan_chunk = quantum if pending else saved_chunk
+                if not chained:
+                    # legacy TTFT protection: cap the stretch while
+                    # arrivals are outstanding (the chained path joins
+                    # them mid-stretch instead)
+                    self.scan_chunk = quantum if pending else saved_chunk
                 starters = prefill_starters()
                 self.profiler.tick_begin()
                 self._tick()
@@ -1885,7 +2316,10 @@ class RequestManager:
                 self._maybe_check_health()
                 self._maybe_brownout()
                 for rid in starters:
-                    if self.requests[rid].prefill_offset > 0:
+                    # a mid-stretch join already stamped (and telemetered)
+                    # its own prefill start — don't re-stamp it here
+                    if (self.requests[rid].prefill_offset > 0
+                            and "prefill_start_s" not in records[rid]):
                         records[rid]["prefill_start_s"] = now
                         if tel.enabled:
                             tel.request_prefill_started(
@@ -1897,6 +2331,8 @@ class RequestManager:
             self._maybe_check_health(force=True)
         finally:
             self.scan_chunk = saved_chunk
+            self._arrival_pump = None
+            self._join_stamp = None
             self._swap_clock(saved_clock)
         end = clock() - t0
         for rid, rec in records.items():

@@ -44,6 +44,17 @@ from .batch_config import (
     TreeSearchBatchConfig,
     TreeVerifyBatchConfig,
 )
+from .inference_manager import (
+    EXIT_BUDGET,
+    EXIT_EOS,
+    EXIT_NOT_IN_BATCH,
+    EXIT_RUNNING,
+)
+
+# per-slot budget sentinel for "no device-side max-new exit" (init_carry
+# budget=None): far above any reachable emission count, so the budget
+# truncation below is the identity
+_NO_BUDGET = np.int32(2 ** 30)
 
 
 def _pad_flat(arr, cap, fill):
@@ -134,7 +145,7 @@ class SpecDecodeScan:
 
     # ------------------------------------------------------------------
     def init_carry(self, root_tokens, llm_committed, ssm_committed, finished,
-                   spec_mask=None):
+                   spec_mask=None, budget=None):
         """Build the scan carry from host bookkeeping (post-prefill).
 
         ``root_tokens[r]``: last generated token per slot (the tree root);
@@ -147,10 +158,24 @@ class SpecDecodeScan:
         mixed spec/non-spec macro-step).  Plain rows still ride the
         catch-up feed, so their SSM cache stays current and a host-side
         flip between ``run()`` windows needs no rebuild.
+
+        ``budget[r]`` (default unbounded): remaining new-token allowance
+        per slot — the DEVICE-side max-new exit.  A macro-step truncates
+        a row's emissions at its budget and freezes the slot, exactly
+        where the host's ``_maybe_finish`` would (emission order: budget
+        cut first, then EOS truncation of the survivors — first
+        terminator along the token stream wins, like the per-token host
+        check).  ``carry["exit_code"]`` reports why each slot froze
+        (EXIT_EOS / EXIT_BUDGET; EXIT_RUNNING while live,
+        EXIT_NOT_IN_BATCH for slots finished at entry) — one readback at
+        window end covers lifecycle too.
         """
         R, D = self.llm.max_requests, self.depth
         if spec_mask is None:
             spec_mask = [True] * R
+        if budget is None:
+            budget = np.full(R, _NO_BUDGET, np.int32)
+        fin0 = np.asarray(finished, bool)
         return dict(
             llm_state=self.llm.state,
             ssm_state=self.ssm.state,
@@ -168,6 +193,9 @@ class SpecDecodeScan:
             backlog_n=jnp.zeros((R,), jnp.int32),
             finished=jnp.asarray(finished, bool),
             spec=jnp.asarray(spec_mask, bool),
+            budget=jnp.asarray(budget, jnp.int32),
+            exit_code=jnp.where(jnp.asarray(fin0), EXIT_NOT_IN_BATCH,
+                                EXIT_RUNNING).astype(jnp.int32),
         )
 
     def run(self, carry, n_macro: int, sample=None):
@@ -390,18 +418,35 @@ class SpecDecodeScan:
         f_cnt = jnp.sum(srcs >= 0, axis=1).astype(jnp.int32)       # children
         cnt = jnp.where(fin, 0, f_cnt + 1)   # accepted nodes incl. root
 
+        # Device-side max-new exit: cut each row's emissions at its
+        # remaining budget.  The budget cut runs BEFORE the EOS scan of
+        # the survivors so the first terminator along the token stream
+        # wins — exactly the host's per-token _maybe_finish order.
+        bud = c["budget"]
+        valid = e >= 0
+        eidx = (jnp.cumsum(valid.astype(jnp.int32), axis=1)
+                - valid.astype(jnp.int32))                         # [R, D+1]
+        e_b = jnp.where(valid & (eidx < bud[:, None]), e, -1)
+
         # EOS: truncate after the first eos and freeze the slot
         if self.eos is not None:
-            iseos = (e == self.eos) & (e >= 0)
+            iseos = (e_b == self.eos) & (e_b >= 0)
             after = (jnp.cumsum(iseos.astype(jnp.int32), axis=1)
                      - iseos.astype(jnp.int32)) > 0
-            e_out = jnp.where(after, -1, e)
+            e_out = jnp.where(after, -1, e_b)
             finishing = iseos.any(1)
         else:
-            e_out = e
+            e_out = e_b
             finishing = jnp.zeros((R,), bool)
-        fin_new = fin | finishing
+        n_emit = jnp.sum(e_out >= 0, axis=1).astype(jnp.int32)
+        bud_new = jnp.where(fin, bud, bud - n_emit)
+        hit_budget = ~fin & ~finishing & (bud_new <= 0)
+        fin_new = fin | finishing | hit_budget
         cont = ~fin_new
+        ecode = jnp.where(
+            ~fin & finishing, EXIT_EOS,
+            jnp.where(hit_budget, EXIT_BUDGET,
+                      c["exit_code"])).astype(jnp.int32)
 
         # ---- bookkeeping for the next macro step ----
         commit_src = jnp.concatenate(
@@ -423,6 +468,8 @@ class SpecDecodeScan:
             backlog_n=jnp.where(cont, cnt, 0),
             finished=fin_new,
             spec=smask,
+            budget=bud_new,
+            exit_code=ecode,
         )
         return c2, e_out
 

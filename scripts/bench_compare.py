@@ -56,6 +56,7 @@ def _counter_keys():
         from flexflow_tpu.obs.profiler import WORK_COUNTERS
         from flexflow_tpu.obs.telemetry import (
             FLEET_REGRESSION_COUNTERS,
+            HOST_TICK_REGRESSION_COUNTERS,
             SLO_REGRESSION_COUNTERS,
         )
 
@@ -66,10 +67,14 @@ def _counter_keys():
         # (more replicas failing per served token).  Same for the
         # SLO-lane counters (serve/slo.py): more shed/deferred requests
         # or more brownout escalations for the same seeded overload
-        # means the lanes degrade less gracefully.
+        # means the lanes degrade less gracefully.  The host-tick ratios
+        # (dispatches per token, host syncs per stretch) are derived
+        # from exact counters over a deterministic schedule, so they
+        # join the exact class too.
         _COUNTER_KEYS = frozenset(WORK_COUNTERS) \
             | frozenset(FLEET_REGRESSION_COUNTERS) \
-            | frozenset(SLO_REGRESSION_COUNTERS)
+            | frozenset(SLO_REGRESSION_COUNTERS) \
+            | frozenset(HOST_TICK_REGRESSION_COUNTERS)
     return _COUNTER_KEYS
 
 

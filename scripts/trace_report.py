@@ -18,8 +18,9 @@ auto-applied to the search's predictions.
 
 The ``time_budget`` section is the step-level cost attribution view
 (obs/profiler.py, present when a ``StepProfiler`` was bound to the
-exporting handle): per-phase host time totals/fractions (host_prepare /
-dispatch / per-stage + hop / readback), the deterministic work counters
+exporting handle): per-phase host time totals/fractions (host_admit /
+host_prepare / dispatch / per-stage + hop / readback), the deterministic
+work counters
 (flops, KV bytes touched, dispatches, jit recompiles, host syncs, pages
 mapped/COW'd — the ``scripts/bench_compare.py`` guardrail fields), and
 the per-plan per-COMPONENT predicted-vs-executed error table

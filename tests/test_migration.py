@@ -346,6 +346,10 @@ def test_chaos_migration_plus_dispatch_faults_all_terminal():
         im, greedy(6), fault_injector=inj,
         resilience=ResilienceConfig(retry=RetryPolicy(max_retries=6,
                                                       backoff_s=0.0))))
+    # tick-paced decode: chained stretches consolidate dispatch sites, so
+    # the seeded injector barely fires — this test wants MANY fault
+    # opportunities interleaved with the migration phases
+    rm.chain_segments = False
     ctrl = midflight_ctrl(
         rm, lambda cand: make_im(max_seq=64, kv_page_size=16))
     ctrl.request_migration("tp1_pp1_m1_paged")

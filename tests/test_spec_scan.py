@@ -190,3 +190,60 @@ def test_scan_mixed_spec_mask_matches_incremental():
     assert all(int((em[s, 1] >= 0).sum()) == 1 for s in range(n_macro))
     assert len(seq[1]) == 1 + n_macro
     assert seq[1] == want[1][: 1 + n_macro]
+
+
+def test_scan_budget_freezes_slot_with_exit_code():
+    """Device-side max-new exit for the spec path: per-slot budgets in
+    the carry (``init_carry(budget=...)``) truncate emissions exactly
+    where the host's ``_maybe_finish`` would, freeze the slot, and the
+    carry's ``exit_code`` says why — lifecycle rides the one readback
+    per ``run()`` window."""
+    from flexflow_tpu.serve.inference_manager import (
+        EXIT_BUDGET,
+        EXIT_RUNNING,
+    )
+
+    def streams(em):
+        outs = []
+        for r in range(2):
+            seq = []
+            for step in range(em.shape[0]):
+                seq += [int(t) for t in em[step, r] if t >= 0]
+            outs.append(seq)
+        return outs
+
+    llm, ssm = _rig(2, 2, "auto")
+    llm.reset()
+    ssm.reset()
+    llm.tree_token_layout = None
+    firsts = prefill(llm, PROMPTS)
+    prefill(ssm, PROMPTS)
+    sc = SpecDecodeScan(llm, ssm, width=2, depth=2)
+    # unbudgeted reference window
+    carry = sc.init_carry(
+        firsts, [len(p) for p in PROMPTS], [len(p) for p in PROMPTS],
+        [False, False])
+    em_ref, carry_ref = sc.run(carry, n_macro=8)
+    full = streams(np.asarray(em_ref))
+    assert len(full[0]) >= 5 and len(full[1]) >= 3
+    assert np.asarray(carry_ref["exit_code"]).tolist() == [
+        EXIT_RUNNING, EXIT_RUNNING]
+
+    # budgeted run: row 0 may emit 4 more tokens, row 1 only 2 — each
+    # stream is the exact prefix of the unbudgeted run, then frozen
+    llm.reset()
+    ssm.reset()
+    llm.tree_token_layout = None
+    assert prefill(llm, PROMPTS) == firsts
+    prefill(ssm, PROMPTS)
+    carry = sc.init_carry(
+        firsts, [len(p) for p in PROMPTS], [len(p) for p in PROMPTS],
+        [False, False], budget=[4, 2])
+    em_b, carry_b = sc.run(carry, n_macro=8)
+    got = streams(np.asarray(em_b))
+    assert got[0] == full[0][:4]
+    assert got[1] == full[1][:2]
+    assert np.asarray(carry_b["finished"]).tolist() == [True, True]
+    assert np.asarray(carry_b["exit_code"]).tolist() == [
+        EXIT_BUDGET, EXIT_BUDGET]
+    assert np.asarray(carry_b["budget"]).tolist() == [0, 0]

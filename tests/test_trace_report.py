@@ -427,6 +427,35 @@ def test_dry_run_slo_overload_demonstrates_graceful_degradation(dryrun):
     assert reported == s, "trace_report.py diverged on slo events"
 
 
+def test_dry_run_host_tick_kills_the_host_tick(dryrun):
+    """ISSUE 17 acceptance: the same seeded Poisson stream served on the
+    legacy quantum-1 loop and on the chained decode engine — token
+    streams bit-identical (greedy AND seeded), exactly one host sync per
+    decode stretch (arrivals pending mid-stretch included), dispatches
+    amortized across the stretch, and a second identical serve on the
+    same manager recompiles nothing."""
+    _, doc = dryrun
+    ht = doc["observability"]["host_tick"]
+    for variant in (ht, ht["seeded"]):
+        assert variant["bit_identical"], \
+            "legacy and chained streams diverged"
+        legacy = variant["legacy_quantum1"]
+        chain = variant["chained"]
+        # the host-sync collapse: exactly one readback per stretch
+        assert chain["host_syncs_per_stretch"] == 1.0
+        assert chain["max_syncs_per_stretch"] == 1
+        assert chain["host_syncs"] < legacy["host_syncs"]
+        # dispatch amortization: strictly fewer dispatches per token
+        assert chain["dispatches_per_token"] < legacy["dispatches_per_token"]
+        assert chain["total_tokens"] == legacy["total_tokens"]
+    # greedy-only instrumentation: a mid-stretch arrival joined the
+    # running batch, and steady state compiles nothing
+    assert ht["chained"]["stretch_joins"] >= 1
+    assert ht["chained"]["steady_state_recompiles"] == 0
+    # stretches genuinely chained segments (not one dispatch per stretch)
+    assert ht["chained"]["dispatches_per_stretch"] > 1.0
+
+
 def test_dry_run_artifact_guards_with_bench_compare(dryrun, tmp_path):
     """The regression comparator is the loop's guardrail: the dry-run
     section compares clean against itself and trips on an injected
@@ -465,7 +494,8 @@ def test_check_mode_validates_dry_run_schema(dryrun):
                   doc["observability"]["live_migration"]["paths"]["jsonl"],
                   doc["observability"]["step_profile"]["paths"]["jsonl"],
                   doc["observability"]["fleet_serving"]["paths"]["jsonl"],
-                  doc["observability"]["slo_overload"]["paths"]["jsonl"]):
+                  doc["observability"]["slo_overload"]["paths"]["jsonl"],
+                  doc["observability"]["host_tick"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
