@@ -2541,6 +2541,143 @@ def host_tick_dryrun(out_dir=None):
     }
 
 
+def trace_replay_dryrun(out_dir=None):
+    """Hermetic ``--dry-run`` time-travel serving section
+    (obs/replay.py): record -> replay -> what-if, no device work.
+
+    * **record** — a seeded Poisson arrival stream (priorities, TTLs,
+      varied budgets) served through ``serve_with_arrivals(...,
+      record_trace=TrafficTraceRecorder(path))`` on the virtual clock,
+      greedy AND seeded sampling; the versioned JSONL trace artifact
+      (gen/sampling seeds, plan key, per-arrival prompts + hashes,
+      per-request outcomes + latency decomposition) lands next to the
+      telemetry export.
+    * **fidelity replay** — ``ReplayHarness`` loads the artifact, pins
+      the recorded gen config onto a FRESH identically-built engine,
+      and re-drives the stream: per-request token streams and terminal
+      outcomes must be BIT-IDENTICAL to the recording (the ``(rid,
+      token_index)`` sample fold makes streams a pure function of the
+      request), verified from the artifact alone.
+    * **what-if replay** — the recorded stream priced against two plan
+      candidates (tp1_pp1 vs tp1_pp2_m2, the calibration scenario's
+      component cost model) through the harness's deterministic
+      slot-level simulation; the delta table diffs the candidates under
+      ``scripts/bench_compare.py``'s exact-counter/thresholded-latency
+      discipline (``ReplayHarness.diff``).
+
+    The exported JSONL rides the EVENT_SCHEMA "replay" category
+    (``trace_recorded`` / ``replay_started`` / ``replay_completed``)
+    and round-trips through ``scripts/trace_report.py --check``;
+    ``replay_mismatches`` and ``telemetry_events_dropped`` join
+    ``bench_compare``'s exact class (zero in a healthy run).
+    """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.replay import (
+        ReplayHarness,
+        TrafficTrace,
+        TrafficTraceRecorder,
+    )
+    from flexflow_tpu.obs.report import summarize_jsonl
+    from flexflow_tpu.search.serve_search import price_plan
+    from flexflow_tpu.serve import GenerationConfig, RequestManager
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    os.makedirs(out_dir, exist_ok=True)
+    tel = Telemetry(clock=_Tick())
+
+    def tiny_im():
+        return build_im(False, layers=2, hidden=32, heads=2, kv=2, inter=48,
+                        vocab=64, max_requests=2, max_seq=64, max_tokens=16)
+
+    # seeded open-loop stream with per-request options: priorities vary
+    # (admission-order coverage), one tight TTL (a timeout outcome the
+    # replay must reproduce), varied budgets
+    rng = np.random.RandomState(13)
+    arrivals = []
+    t = 0.0
+    for i in range(6):
+        t += float(rng.exponential(1.0 / 250.0))
+        prompt = [int(x) for x in rng.randint(1, 63, size=rng.randint(3, 7))]
+        opts = {"priority": int(rng.randint(0, 3))}
+        if i == 3:
+            opts["ttl_s"] = 0.004
+        arrivals.append((t, prompt, int(rng.randint(4, 10)), opts))
+
+    variants = {}
+    trace_paths = {}
+    for mode, gen in (("greedy", GenerationConfig(max_new_tokens=8)),
+                      ("seeded", GenerationConfig(max_new_tokens=8,
+                                                  temperature=0.8,
+                                                  top_p=0.9, seed=7))):
+        trace_path = os.path.join(out_dir,
+                                  f"dryrun_trace_replay_{mode}.trace.jsonl")
+        im = tiny_im()
+        rm = RequestManager(im, gen, telemetry=tel)
+        recorder = TrafficTraceRecorder(path=trace_path, telemetry=tel)
+        recorded = rm.serve_with_arrivals(list(arrivals), clock=_Tick(),
+                                          record_trace=recorder)
+        release_im(im)
+
+        # fidelity: a FRESH identically-built engine driven from the
+        # artifact alone (the harness pins the recorded gen/seed)
+        trace = TrafficTrace.load(trace_path)
+        harness = ReplayHarness(trace, telemetry=tel)
+        im2 = tiny_im()
+        rm2 = RequestManager(im2, GenerationConfig(), telemetry=tel)
+        replayed = harness.replay(rm2, clock=_Tick())
+        fidelity = harness.verify(replayed)
+        release_im(im2)
+        trace_paths[mode] = trace_path
+        variants[mode] = {
+            "bit_identical": fidelity["bit_identical"],
+            "requests": fidelity["requests"],
+            "mismatches": len(fidelity["mismatches"]),
+            "outcomes": {r["trace_id"]: r["outcome"]
+                         for r in recorded.values()},
+        }
+
+    # what-if: the seeded recording priced against two candidates on the
+    # calibration scenario's machine — per-class latency/goodput/outcome
+    # deltas with no device attached
+    scen = calibration_scenario()
+    ff, devices, mm = scen["ff"], scen["devices"], scen["mm_true"]
+    harness = ReplayHarness(TrafficTrace.load(trace_paths["seeded"]),
+                            telemetry=tel)
+    base = harness.what_if(
+        price_plan(ff, 1, 1, machine=mm, devices=devices[:1]))
+    cand = harness.what_if(
+        price_plan(ff, 1, 2, 2, machine=mm, devices=devices))
+    delta = harness.diff(base["summary"], cand["summary"])
+
+    paths = tel.export(out_dir, prefix="dryrun_trace_replay")
+    summary = summarize_jsonl(paths["jsonl"])
+    return {
+        "paths": paths,
+        "trace_paths": trace_paths,
+        "summary": summary,
+        **variants["greedy"],
+        "seeded": variants["seeded"],
+        "what_if": {
+            "old": base["candidate"],
+            "new": cand["candidate"],
+            "old_goodput_tokens_per_sec":
+                base["summary"].get("goodput_tokens_per_sec"),
+            "new_goodput_tokens_per_sec":
+                cand["summary"].get("goodput_tokens_per_sec"),
+            "diff": delta,
+        },
+        "note": "seeded arrival stream recorded as a versioned trace "
+                "artifact, replayed bit-identically (greedy AND seeded) "
+                "on a fresh engine from the artifact alone, then priced "
+                "against tp1_pp1 vs tp1_pp2_m2 candidates through the "
+                "what-if slot simulation; replay_mismatches and "
+                "telemetry_events_dropped are bench_compare exact-class "
+                "fields (zero here)",
+    }
+
+
 def bench_shared_prefix(ctx=256, n_users=16, shared_len=1536,
                         suffix_len=128, max_new=32, page=512):
     """DEVICE shared-prefix serving section: N users x one system prompt,
@@ -2623,6 +2760,7 @@ def main(argv=None):
             args.out)
         doc["observability"]["slo_overload"] = slo_overload_dryrun(args.out)
         doc["observability"]["host_tick"] = host_tick_dryrun(args.out)
+        doc["observability"]["trace_replay"] = trace_replay_dryrun(args.out)
         print(json.dumps(doc))
         return
 

@@ -62,7 +62,25 @@ def main():
                          "JSON + JSONL) to this directory (default: the "
                          "--profile run dir when profiling, else no "
                          "export; the summary always prints)")
+    ap.add_argument("--record-trace", default="", metavar="PATH",
+                    help="serve the demo prompts as an arrival stream "
+                         "and capture it as a versioned traffic-trace "
+                         "JSONL (obs/replay.py): gen/sampling seeds, "
+                         "plan key, per-arrival prompts + hashes, "
+                         "per-request outcomes — replayable with "
+                         "--replay-trace")
+    ap.add_argument("--replay-trace", default="", metavar="PATH",
+                    help="re-drive a recorded traffic trace against "
+                         "this deployment instead of the demo prompts: "
+                         "pins the recorded gen config/seed, replays "
+                         "the arrival stream, and verifies per-request "
+                         "token streams + outcomes are bit-identical "
+                         "to the recording (same plan + identical "
+                         "weights; a different plan reports the "
+                         "mismatches instead)")
     args = ap.parse_args()
+    if args.record_trace and args.replay_trace:
+        ap.error("--record-trace and --replay-trace are exclusive")
 
     if args.cpu:
         from flexflow_tpu.utils.platform import force_cpu
@@ -148,8 +166,29 @@ def main():
     ]
     out_dir = args.telemetry_out or None
     t0 = time.perf_counter()
+    fidelity = None
     with maybe_profile(args.profile, trace_dir=out_dir) as prof_dir:
-        outs = rm.generate(prompts)
+        if args.replay_trace:
+            from flexflow_tpu.obs.replay import ReplayHarness, TrafficTrace
+
+            trace = TrafficTrace.load(args.replay_trace)
+            harness = ReplayHarness(trace, telemetry=tel)
+            records = harness.replay(rm)
+            fidelity = harness.verify(records)
+            prompts = [a["prompt"] for a in trace.arrivals]
+            outs = [records[r]["tokens"] for r in sorted(records)]
+        elif args.record_trace:
+            from flexflow_tpu.obs.replay import TrafficTraceRecorder
+
+            recorder = TrafficTraceRecorder(path=args.record_trace,
+                                            telemetry=tel)
+            arrivals = [(0.002 * i, p, args.max_new_tokens)
+                        for i, p in enumerate(prompts)]
+            records = rm.serve_with_arrivals(arrivals,
+                                             record_trace=recorder)
+            outs = [records[r]["tokens"] for r in sorted(records)]
+        else:
+            outs = rm.generate(prompts)
     dt = time.perf_counter() - t0
     for p, o in zip(prompts, outs):
         print(f"prompt[{len(p)} toks] -> {o}")
@@ -158,6 +197,14 @@ def main():
         f"served {len(prompts)} requests, {total} tokens in {rm.steps} steps, "
         f"{dt:.2f}s ({total / dt:.1f} tok/s incl. compile)"
     )
+    if args.record_trace:
+        print(f"traffic trace recorded: {args.record_trace} "
+              f"(replay with --replay-trace)")
+    if fidelity is not None:
+        verdict = ("BIT-IDENTICAL" if fidelity["bit_identical"]
+                   else f"{len(fidelity['mismatches'])} MISMATCHES")
+        print(f"replay fidelity: {verdict} over "
+              f"{fidelity['requests']} recorded requests")
 
     snap = tel.metrics.snapshot()
     tpot = snap.get("tpot_s", {})

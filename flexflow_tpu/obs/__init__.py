@@ -44,6 +44,13 @@ from .profiler import (
     plan_cost_card,
     profiler_or_null,
 )
+from .replay import (
+    TRACE_VERSION,
+    ReplayHarness,
+    TrafficTrace,
+    TrafficTraceRecorder,
+    VirtualClock,
+)
 from .report import (
     memory_section,
     summarize_events,
@@ -99,4 +106,9 @@ __all__ = [
     "COMPONENTS",
     "TIME_COMPONENT_FIELDS",
     "WORK_COUNTERS",
+    "TrafficTraceRecorder",
+    "TrafficTrace",
+    "ReplayHarness",
+    "VirtualClock",
+    "TRACE_VERSION",
 ]

@@ -58,6 +58,12 @@ _FAILOVER = "request_failed_over"
 # explicit lane sheds
 _BROWNOUT = "brownout_level_changed"
 _LANE_SHED = "lane_shed"
+# time-travel serving (obs/replay.py).  replay_mismatch carries a
+# trace_id, so these MUST be intercepted before the per-request
+# trace_id branch — a mismatch instant is about a replay, not a new
+# request, and must not inflate the request count.
+_REPLAY_EVENTS = ("trace_recorded", "replay_started", "replay_completed",
+                  "replay_mismatch")
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -89,6 +95,7 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     failovers: List[Dict] = []
     brownout_changes: List[Dict] = []
     lane_sheds: List[Dict] = []
+    replay_events: Dict[str, List[Dict]] = {n: [] for n in _REPLAY_EVENTS}
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -138,6 +145,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name == _LANE_SHED:
             lane_sheds.append(ev.get("args", {}))
+            continue
+        if name in replay_events:
+            replay_events[name].append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -225,6 +235,14 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             "brownout_changes": brownout_changes,
             "lane_shed": lane_sheds,
         },
+        # time-travel serving (obs/replay.py): trace artifacts saved,
+        # replay runs, and per-request fidelity violations
+        "replay": {
+            "recorded": replay_events["trace_recorded"],
+            "started": replay_events["replay_started"],
+            "completed": replay_events["replay_completed"],
+            "mismatches": replay_events["replay_mismatch"],
+        },
     }
 
 
@@ -310,6 +328,20 @@ def summarize_jsonl(path: str) -> Dict:
     summary["slo"]["lane_depths"] = {
         k: metrics[k] for k in sorted(metrics)
         if k.startswith("lane_pending_depth_")}
+    # time-travel serving view: the replay events summarize_events
+    # collected + the exact registry counters (REPLAY_COUNTERS —
+    # replay_mismatches joins bench_compare's exact class at threshold
+    # zero: any mismatch means determinism regressed)
+    from .telemetry import REPLAY_COUNTERS
+
+    summary["replay"]["counters"] = {
+        k: metrics[k] for k in REPLAY_COUNTERS if k in metrics}
+    # trace-drop hardening: surface the ring buffer's dropped-event
+    # count under the exact-class regression counter name, so every
+    # bench section that embeds a summary carries it into bench_compare
+    # (a section silently losing telemetry events fails CI, not just a
+    # stderr warning in trace_report)
+    summary["telemetry_events_dropped"] = summary["dropped"]
 
     pred_err: Dict[str, Dict] = {}
     for plan, fields in calibration.get("plans", {}).items():
@@ -497,7 +529,7 @@ def validate_jsonl(path: str) -> List[str]:
         # typed vocabulary: the categories the report parses semantically
         cat = doc.get("cat")
         if ph == "i" and cat in ("request", "dispatch", "plan", "profile",
-                                 "fleet", "slo"):
+                                 "fleet", "slo", "replay"):
             name = doc["name"]
             schema = EVENT_SCHEMA.get(name)
             if schema is None:

@@ -116,6 +116,16 @@ EVENT_SCHEMA = {
     # by the ladder (explicit REJECTED — never FAILED)
     "brownout_level_changed": ("slo", ("level", "from_level")),
     "lane_shed": ("slo", ("slo_class",)),
+    # time-travel serving (obs/replay.py): a traffic-trace artifact
+    # landed on disk (trace_recorded), a ReplayHarness run started /
+    # finished (mode carries fidelity|what_if), and one per-request
+    # fidelity violation (replay_mismatch names the request and the
+    # field — tokens/outcome/failovers — that diverged from the
+    # recording; a bit-identical replay emits ZERO of these)
+    "trace_recorded": ("replay", ("arrivals",)),
+    "replay_started": ("replay", ("mode",)),
+    "replay_completed": ("replay", ("mode",)),
+    "replay_mismatch": ("replay", ("trace_id", "field")),
 }
 
 # migration counter/gauge vocabulary (report.py folds these into the
@@ -184,6 +194,31 @@ SLO_REGRESSION_COUNTERS = (
 # of the regression class — its direction depends on the arrival mix.
 HOST_TICK_REGRESSION_COUNTERS = (
     "dispatches_per_token", "host_syncs_per_stretch",
+)
+
+# Trace-replay counter vocabulary (obs/replay.py; report.py folds these
+# into the ``replay`` summary section — one tuple shared by the
+# emitters, the report, and the bench ``trace_replay`` dry-run).  All
+# exact cumulative counters.
+REPLAY_COUNTERS = (
+    "traces_recorded", "replays_run", "replay_mismatches",
+)
+
+# the monotone bad-if-increasing subset joining bench_compare's exact
+# class: ANY replay mismatch means a recorded run stopped replaying
+# bit-identically — the strongest determinism regression signal the
+# repo has, so the threshold is exactly zero.
+REPLAY_REGRESSION_COUNTERS = (
+    "replay_mismatches",
+)
+
+# Trace-drop hardening: the TraceRecorder ring buffer's dropped-event
+# count was only a stderr WARNING in trace_report; as an exact-class
+# counter, a bench section that silently starts losing telemetry events
+# (capacity regression, emit storm) fails bench_compare instead.
+# report.py stamps it into every summary from the telemetry_meta line.
+TRACE_REGRESSION_COUNTERS = (
+    "telemetry_events_dropped",
 )
 
 
@@ -517,6 +552,47 @@ class Telemetry:
             self.metrics.gauge(f"lane_pending_depth_{name}").set(depth)
             self.trace.counter(f"lane_pending_depth_{name}", depth)
 
+    def trace_recorded(self, arrivals: int, path: str = "",
+                       requests: int = 0) -> float:
+        """A traffic-trace artifact (obs/replay.py JSONL) landed on
+        disk: ``arrivals`` offered requests, ``requests`` finished
+        outcome lines."""
+        self.metrics.counter("traces_recorded").inc()
+        return self.trace.instant("trace_recorded", "replay", "replay",
+                                  arrivals=arrivals, path=path,
+                                  requests=requests)
+
+    def replay_started(self, mode: str, driver: str = "",
+                       arrivals: int = 0) -> float:
+        """A ReplayHarness run began re-driving a recorded trace
+        (``mode`` is fidelity|what_if)."""
+        return self.trace.instant("replay_started", "replay", "replay",
+                                  mode=mode, driver=driver,
+                                  arrivals=arrivals)
+
+    def replay_completed(self, mode: str, bit_identical=None,
+                         mismatches: int = 0) -> float:
+        """A ReplayHarness run finished (``bit_identical`` is the
+        fidelity verdict; None for what-if runs, which price a DIFFERENT
+        plan and have no bit-identity contract)."""
+        self.metrics.counter("replays_run").inc()
+        # materialize the mismatch counter at 0 even on a clean run: the
+        # exact-class guard only fires when the REFERENCE artifact
+        # carries the field, so a healthy baseline must export it
+        self.metrics.counter("replay_mismatches").inc(0)
+        return self.trace.instant("replay_completed", "replay", "replay",
+                                  mode=mode, bit_identical=bit_identical,
+                                  mismatches=mismatches)
+
+    def replay_mismatch(self, trace_id: str, field: str) -> float:
+        """One per-request fidelity violation: ``field`` (tokens /
+        outcome / failovers / presence) diverged from the recording.
+        Exact-class regression counter — any increase fails
+        bench_compare."""
+        self.metrics.counter("replay_mismatches").inc()
+        return self.trace.instant("replay_mismatch", "replay", "replay",
+                                  trace_id=trace_id, field=field)
+
     def spec_batch_mix(self, spec_requests: int, plain_requests: int) -> None:
         """One mixed verify macro-step's request composition: how many
         rows shipped a draft tree (multi-token verify) vs a root-only
@@ -759,6 +835,18 @@ class NullTelemetry:
 
     def lane_depths(self, *a, **k):
         return None
+
+    def trace_recorded(self, *a, **k):
+        return 0.0
+
+    def replay_started(self, *a, **k):
+        return 0.0
+
+    def replay_completed(self, *a, **k):
+        return 0.0
+
+    def replay_mismatch(self, *a, **k):
+        return 0.0
 
     def spec_batch_mix(self, *a, **k):
         return None

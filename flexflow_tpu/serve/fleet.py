@@ -1238,8 +1238,39 @@ class FleetRouter:
         out = self.serve_all()
         return [out[rid] for rid in rids]
 
-    def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8
-                            ) -> Dict[int, Dict]:
+    def trace_run_meta(self) -> Dict:
+        """Provenance header a traffic trace (obs/replay.py) records for
+        this fleet: the shared gen config + fault schedule like a single
+        manager's, plus the topology (replica names + per-replica plan
+        shapes) and the scheduled-kill schedule — what makes a recorded
+        chaos run (replica death mid-stream) replayable from the
+        artifact alone."""
+        from ..obs.replay import engine_shape_of, injector_meta
+
+        meta: Dict = {
+            "driver": type(self).__name__,
+            "gen": dataclasses.asdict(self.gen),
+            # the fleet-level plan slot carries replica0's engine shape
+            # (capacity fields the what-if simulator scales by fleet
+            # size); per-replica shapes ride the fleet section
+            "plan": (engine_shape_of(self.replicas[0].rm.im)
+                     if self.replicas else {}),
+            "fault": injector_meta(self.injector),
+            "fleet": {
+                "replicas": len(self.replicas),
+                "names": [rep.name for rep in self.replicas],
+                "plans": {rep.name: engine_shape_of(rep.rm.im)
+                          for rep in self.replicas},
+                "kills": {name: int(tick)
+                          for name, tick in self._kills.items()},
+            },
+        }
+        if self.slo is not None and hasattr(self.slo, "snapshot"):
+            meta["slo"] = self.slo.snapshot()
+        return meta
+
+    def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8,
+                            record_trace=None) -> Dict[int, Dict]:
         """Arrival-driven fleet serving — the multi-worker extension of
         :meth:`RequestManager.serve_with_arrivals` (same arrival tuple /
         options-dict contract, same record fields) plus the fleet
@@ -1257,6 +1288,8 @@ class FleetRouter:
         for rep in self.replicas:
             rep.rm._swap_clock(clock)
         t0 = clock()
+        if record_trace is not None:
+            record_trace.begin_run(self.trace_run_meta())
         pending = sorted(arrivals, key=lambda a: a[0])
         records: Dict[int, Dict] = {}
         open_rids: set = set()
@@ -1266,6 +1299,11 @@ class FleetRouter:
             now = clock() - t0
             while pending and pending[0][0] <= now:
                 off, prompt, mnt, *rest = pending.pop(0)
+                if record_trace is not None:
+                    # RAW options element — a malformed dict replays its
+                    # rejection identically
+                    record_trace.record_arrival(
+                        off, prompt, mnt, rest[0] if rest else None)
                 opts, reject = parse_arrival_options(rest)
                 rid = self.register(prompt, mnt, reject_invalid=True,
                                     reject_reason=reject, **opts)
@@ -1338,4 +1376,6 @@ class FleetRouter:
             stop = rec.get("first_token_s", rec.get("finish_s", end))
             rec["queue_wait_s"] = max(start - rec["arrival_s"], 0.0)
             rec["prefill_s"] = max(stop - start, 0.0)
+        if record_trace is not None:
+            record_trace.finalize(records)
         return records

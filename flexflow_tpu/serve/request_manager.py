@@ -2158,7 +2158,27 @@ class RequestManager:
         new_rm = self.migration.tick(self, idle=idle)
         return new_rm if new_rm is not None and new_rm is not self else None
 
+    def trace_run_meta(self) -> Dict:
+        """Provenance header a traffic trace (obs/replay.py) records for
+        this deployment: what a ReplayHarness needs to rebuild an
+        IDENTICAL run — the full gen config (sampling seed included),
+        the plan key + engine shape, the fault-injector schedule, and
+        the SLO-policy snapshot.  Subclasses extend (SpecInferManager
+        adds its draft-tree shape)."""
+        from ..obs.replay import engine_shape_of, injector_meta
+
+        meta: Dict = {
+            "driver": type(self).__name__,
+            "gen": dataclasses.asdict(self.gen),
+            "plan": engine_shape_of(self.im),
+            "fault": injector_meta(self.injector),
+        }
+        if self.slo is not None and hasattr(self.slo, "snapshot"):
+            meta["slo"] = self.slo.snapshot()
+        return meta
+
     def serve_with_arrivals(self, arrivals, clock=None, quantum: int = 8,
+                            record_trace=None,
                             _t0=None, _records=None, _open=None):
         """Arrival-driven serving: requests join the running admit/retire
         loop at their offered times (open-loop load, the serving_under_load
@@ -2199,6 +2219,14 @@ class RequestManager:
         reorders work, never results), pinned by
         tests/test_serving_under_load.py.
 
+        ``record_trace`` (a :class:`~flexflow_tpu.obs.replay.
+        TrafficTraceRecorder`) captures this run as a versioned trace
+        artifact: run provenance (gen/sampling seeds, plan key, fault
+        schedule) on entry, every offered arrival at admit time, and
+        every finished record at the tail — capture is append-only host
+        bookkeeping that never reads this loop's clock, so a recorded
+        run is bit-identical to an unrecorded one.
+
         ``_t0``/``_records``/``_open`` are the live-migration continuation
         (serve/migration.py): when a plan switch completes mid-loop, the
         SUCCESSOR manager re-enters this method with the remaining
@@ -2209,6 +2237,11 @@ class RequestManager:
 
         clock = clock or _time.perf_counter
         t0 = clock() if _t0 is None else _t0
+        if record_trace is not None:
+            # idempotent: a migration successor re-entering this loop
+            # appends its plan provenance as a continuation, not a new
+            # header
+            record_trace.begin_run(self.trace_run_meta())
         pending = sorted(arrivals, key=lambda a: a[0])
         records: Dict[int, Dict] = {} if _records is None else _records
         saved_chunk = self.scan_chunk
@@ -2225,6 +2258,11 @@ class RequestManager:
             now = clock() - t0
             while pending and pending[0][0] <= now:
                 off, prompt, mnt, *rest = pending.pop(0)
+                if record_trace is not None:
+                    # the RAW options element (not the parsed form), so
+                    # a malformed dict replays its rejection identically
+                    record_trace.record_arrival(
+                        off, prompt, mnt, rest[0] if rest else None)
                 # malformed arrivals — bad prompt shapes AND bad options
                 # dicts — register as REJECTED records instead of raising
                 # out of (and killing) the serve loop
@@ -2265,6 +2303,7 @@ class RequestManager:
             # loop with the remaining arrivals on the original time base
             return new_rm.serve_with_arrivals(
                 pending, clock=clock, quantum=quantum,
+                record_trace=record_trace,
                 _t0=t0, _records=records, _open=open_rids)
 
         def stamp_joined(rids):
@@ -2363,6 +2402,11 @@ class RequestManager:
             stop = rec.get("first_token_s", rec.get("finish_s", end))
             rec["queue_wait_s"] = max(start - rec["arrival_s"], 0.0)
             rec["prefill_s"] = max(stop - start, 0.0)
+        if record_trace is not None:
+            # only the FINAL manager of a migration chain reaches this
+            # tail (intermediate callers return via continue_on above),
+            # so the artifact finalizes exactly once, with every record
+            record_trace.finalize(records)
         return records
 
     def serve_incr_decoding(self) -> Dict[int, List[int]]:

@@ -147,6 +147,10 @@ class FaultInjector:
     def __init__(self, seed: int = 0, p: float = 0.0,
                  p_by_site: Optional[Dict[str, float]] = None,
                  max_faults: Optional[int] = None):
+        # retained verbatim so a traffic trace (obs/replay.py) can
+        # record the full fault schedule's provenance and rebuild an
+        # identical injector at replay time
+        self.seed = int(seed)
         self.p = float(p)
         self.p_by_site = dict(p_by_site or {})
         self.max_faults = max_faults

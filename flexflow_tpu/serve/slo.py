@@ -149,6 +149,18 @@ class SLOPolicy:
     def get(self, name: str) -> Optional[SLOClass]:
         return self.classes.get(name)
 
+    def snapshot(self) -> Dict:
+        """Serializable policy provenance for a traffic-trace header
+        (obs/replay.py): every class's full knob set + the default lane.
+        Replay does not rebuild a policy from this — the caller wires
+        its own — but a what-if report keys per-class deltas on it and
+        a fidelity check can assert the replayed policy matches."""
+        return {
+            "default_class": self.default_class,
+            "classes": {name: dataclasses.asdict(cls)
+                        for name, cls in sorted(self.classes.items())},
+        }
+
     @staticmethod
     def default(lc_reservation_frac: float = 0.25,
                 lc_ttft_p95_s: Optional[float] = None,

@@ -191,6 +191,18 @@ class SpecInferManager(RequestManager):
             ssm.publish_memory(self.telemetry,
                                key=ssm.plan_key + "_draft")
 
+    def trace_run_meta(self):
+        """Trace provenance (obs/replay.py): the base manager's header
+        plus the draft-tree shape and the draft deployment's plan — a
+        fidelity replay must rebuild the SAME speculation config, and a
+        what-if replay prices spec candidates off these fields."""
+        meta = super().trace_run_meta()
+        from ..obs.replay import engine_shape_of
+
+        meta["spec"] = {"width": self.width, "depth": self.depth,
+                        "draft_plan": engine_shape_of(self.ssm)}
+        return meta
+
     # ------------------------------------------------------------------
     # memory observability over TWO deployments (target + draft)
     # ------------------------------------------------------------------

@@ -5,6 +5,7 @@ Usage::
     python scripts/bench_compare.py OLD.json NEW.json
     python scripts/bench_compare.py OLD.json NEW.json \
         --default-threshold 0.1 --threshold tpot_ms=0.05
+    python scripts/bench_compare.py OLD.json NEW.json --json diff.json
 
 Diffs two JSON bench artifacts (``bench.py`` output, a ``--dry-run``
 section, or any JSON document) field by field and exits NONZERO on
@@ -57,7 +58,9 @@ def _counter_keys():
         from flexflow_tpu.obs.telemetry import (
             FLEET_REGRESSION_COUNTERS,
             HOST_TICK_REGRESSION_COUNTERS,
+            REPLAY_REGRESSION_COUNTERS,
             SLO_REGRESSION_COUNTERS,
+            TRACE_REGRESSION_COUNTERS,
         )
 
         # fleet robustness counters join the deterministic-exact class:
@@ -71,10 +74,17 @@ def _counter_keys():
         # (dispatches per token, host syncs per stretch) are derived
         # from exact counters over a deterministic schedule, so they
         # join the exact class too.
+        # replay_mismatches (obs/replay.py) joins at exact-zero: any
+        # fidelity mismatch means a recorded run stopped replaying
+        # bit-identically.  telemetry_events_dropped hardens trace
+        # drops: the ring buffer silently losing events was only a
+        # stderr warning in trace_report — here it fails the diff.
         _COUNTER_KEYS = frozenset(WORK_COUNTERS) \
             | frozenset(FLEET_REGRESSION_COUNTERS) \
             | frozenset(SLO_REGRESSION_COUNTERS) \
-            | frozenset(HOST_TICK_REGRESSION_COUNTERS)
+            | frozenset(HOST_TICK_REGRESSION_COUNTERS) \
+            | frozenset(REPLAY_REGRESSION_COUNTERS) \
+            | frozenset(TRACE_REGRESSION_COUNTERS)
     return _COUNTER_KEYS
 
 
@@ -172,6 +182,10 @@ def main(argv=None) -> int:
                     metavar="FIELD=FRAC",
                     help="per-field override (leaf key), repeatable")
     ap.add_argument("--indent", type=int, default=None)
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the result document to PATH "
+                         "(machine-readable sink for CI and the replay "
+                         "diff report; exit code unchanged)")
     args = ap.parse_args(argv)
 
     overrides = {}
@@ -190,6 +204,10 @@ def main(argv=None) -> int:
     result["old"] = args.old
     result["new"] = args.new
     print(json.dumps(result, indent=args.indent))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
     return 0 if result["ok"] else 1
 
 

@@ -53,10 +53,22 @@ gauges.  Per-class TTFT/TPOT attainment lives in the
 ``under_load_summary`` ``per_class`` breakdown the bench sections
 carry.
 
+The ``replay`` section is the time-travel view (obs/replay.py):
+``trace_recorded`` artifact saves, ``replay_started`` /
+``replay_completed`` harness runs (mode = fidelity|what_if, the
+bit-identity verdict), per-request ``replay_mismatch`` fidelity
+violations, and the exact ``REPLAY_COUNTERS`` registry view
+(``traces_recorded`` / ``replays_run`` / ``replay_mismatches`` — the
+last joins ``bench_compare``'s exact class at threshold zero).  The
+recorded-vs-replayed diff itself is ``scripts/replay_report.py``.
+
 A trace whose ring buffer dropped events is TRUNCATED — the summary is
 computed from what survived — so ``dropped > 0`` prints an explicit
 warning to stderr (satellite of ISSUE 6: a truncated trace must not
-masquerade as a complete one).
+masquerade as a complete one), and the count is ALSO surfaced as the
+``telemetry_events_dropped`` exact-class counter so a bench section
+that starts losing events fails ``bench_compare`` instead of just
+warning here.
 
 ``--check`` validates the JSONL against the expected event schema
 (:func:`flexflow_tpu.obs.report.validate_jsonl` — line kinds, per-phase

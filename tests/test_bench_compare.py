@@ -152,6 +152,51 @@ def test_compare_importable_and_measured_only_where_present():
     assert res["ok"] and res["compared"] == 1
 
 
+def test_json_output_sink(tmp_path):
+    """``--json PATH`` writes the same result document to a file for
+    machine consumption (CI, the replay diff report) — stdout and the
+    exit code are unchanged."""
+    sink = tmp_path / "diff.json"
+    rc, res = run_cli(DOC, DOC, tmp_path, "--json", str(sink))
+    assert rc == 0
+    on_disk = json.loads(sink.read_text())
+    assert on_disk == res
+    # a regressing diff still writes the sink and still exits 1
+    new = copy.deepcopy(DOC)
+    new["serving_under_load"]["0.5x"]["work"]["dispatches"] = 43
+    rc, res = run_cli(DOC, new, tmp_path, "--json", str(sink))
+    assert rc == 1
+    on_disk = json.loads(sink.read_text())
+    assert not on_disk["ok"] and on_disk["regressions"] == res["regressions"]
+
+
+def test_replay_and_trace_counters_join_the_exact_compare_class():
+    """Time-travel serving (obs/replay.py): any replay mismatch is a
+    determinism regression, and a telemetry ring that starts dropping
+    events fails the diff instead of just warning in trace_report."""
+    for k in ("replay_mismatches", "telemetry_events_dropped"):
+        assert bench_compare.classify(k) == "counter", k
+    # the bookkeeping counters stay unclassified (more traces recorded
+    # or replays run is not monotone-bad)
+    assert bench_compare.classify("traces_recorded") is None
+    assert bench_compare.classify("replays_run") is None
+    old = {"replay": {"counters": {"replay_mismatches": 0}},
+           "telemetry_events_dropped": 0}
+    assert bench_compare.compare(old, old)["ok"]
+    worse = {"replay": {"counters": {"replay_mismatches": 1}},
+             "telemetry_events_dropped": 0}
+    res = bench_compare.compare(old, worse)
+    assert not res["ok"]
+    assert any(r["field"].endswith("replay_mismatches")
+               for r in res["regressions"])
+    dropped = {"replay": {"counters": {"replay_mismatches": 0}},
+               "telemetry_events_dropped": 7}
+    res = bench_compare.compare(old, dropped)
+    assert not res["ok"]
+    assert any(r["field"].endswith("telemetry_events_dropped")
+               for r in res["regressions"])
+
+
 def test_fleet_counters_join_the_exact_compare_class():
     """The fleet robustness counters (serve/fleet.py) diff like
     deterministic work counters: exact by default, an increase is a

@@ -331,7 +331,8 @@ def test_every_emitted_typed_event_is_in_event_schema():
     for path in sources:
         with open(path) as f:
             for name, cat in pat.findall(f.read()):
-                if cat in ("request", "dispatch", "plan", "fleet", "slo"):
+                if cat in ("request", "dispatch", "plan", "fleet", "slo",
+                           "replay"):
                     emitted.add((name, cat))
     assert emitted, "grep found no typed emitters — the pattern broke"
     unknown = {(n, c) for n, c in emitted
@@ -346,3 +347,7 @@ def test_every_emitted_typed_event_is_in_event_schema():
     # SLO-class lanes + brownout (serve/slo.py): the new "slo" category
     assert ("brownout_level_changed", "slo") in emitted
     assert ("lane_shed", "slo") in emitted
+    # time-travel serving (obs/replay.py): the "replay" category
+    assert ("trace_recorded", "replay") in emitted
+    assert ("replay_completed", "replay") in emitted
+    assert ("replay_mismatch", "replay") in emitted

@@ -456,6 +456,72 @@ def test_dry_run_host_tick_kills_the_host_tick(dryrun):
     assert ht["chained"]["dispatches_per_stretch"] > 1.0
 
 
+def test_dry_run_trace_replay_roundtrips(dryrun, tmp_path):
+    """ISSUE 19 acceptance: the hermetic record -> replay -> what-if
+    section.  A recorded ``serve_with_arrivals`` run (greedy AND seeded,
+    with a TTL-timeout outcome in the stream) replayed from its trace
+    artifact on a FRESH engine yields bit-identical per-request token
+    streams and terminal outcomes; the artifact validates through
+    ``replay_report.py --check``; the what-if tp1 vs pp2 delta table is
+    present and priced; the telemetry JSONL's counters join
+    ``bench_compare``'s exact class (replay_mismatches at zero)."""
+    _, doc = dryrun
+    tr = doc["observability"]["trace_replay"]
+    # fidelity: greedy AND seeded, from the artifact alone
+    for variant in (tr, tr["seeded"]):
+        assert variant["bit_identical"], "replayed run diverged"
+        assert variant["mismatches"] == 0
+        assert variant["requests"] == 6
+    # a non-ok outcome (TTL timeout) was recorded AND replayed
+    assert "timeout" in tr["outcomes"].values()
+    # the trace artifact validates through the replay-report CLI
+    check_script = os.path.join(REPO, "scripts", "replay_report.py")
+    for mode in ("greedy", "seeded"):
+        trace_path = tr["trace_paths"][mode]
+        assert os.path.exists(trace_path)
+        res = json.loads(_run([check_script, "--check", trace_path]))
+        assert res["ok"] and res["errors"] == []
+        assert res["arrivals"] == 6 and res["requests"] == 6
+    # ...and summarizes the RECORDED run with the under-load accounting
+    rep = json.loads(_run([check_script, tr["trace_paths"]["seeded"]]))
+    assert rep["recorded"]["requests"] == 6
+    assert rep["recorded"]["outcomes"].get("timeout") == 1
+    # what-if: the tp1_pp1 vs tp1_pp2_m2 delta table, priced and diffed
+    # under bench_compare's discipline
+    wi = tr["what_if"]
+    assert wi["old"]["plan_key"].startswith("tp1_pp1")
+    assert wi["new"]["plan_key"].startswith("tp1_pp2")
+    assert wi["old"]["tpot_ms"] != wi["new"]["tpot_ms"]
+    assert wi["old_goodput_tokens_per_sec"] > 0
+    assert wi["diff"]["compared"] > 0
+    # the exported counters join bench_compare's exact class: a clean
+    # section diffs clean against itself, and an injected mismatch (or
+    # a trace drop) trips the guardrail
+    script = os.path.join(REPO, "scripts", "bench_compare.py")
+    counters = tr["summary"]["replay"]["counters"]
+    assert counters["replay_mismatches"] == 0
+    assert counters["replays_run"] >= 4  # 2 fidelity + 2 what-if
+    assert tr["summary"]["telemetry_events_dropped"] == 0
+    ref = tmp_path / "replay_ref.json"
+    ref.write_text(json.dumps(tr["summary"]))
+    res = json.loads(_run([script, str(ref), str(ref)]))
+    assert res["ok"]
+    import copy
+
+    for field in ("replay_mismatches", "telemetry_events_dropped"):
+        bad = copy.deepcopy(tr["summary"])
+        if field == "replay_mismatches":
+            bad["replay"]["counters"][field] += 1
+        else:
+            bad[field] += 1
+        cand = tmp_path / f"replay_{field}.json"
+        cand.write_text(json.dumps(bad))
+        proc = _run_raw([script, str(ref), str(cand)])
+        assert proc.returncode == 1, f"{field} increase must regress"
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert any(r["field"].endswith(field) for r in out["regressions"])
+
+
 def test_dry_run_artifact_guards_with_bench_compare(dryrun, tmp_path):
     """The regression comparator is the loop's guardrail: the dry-run
     section compares clean against itself and trips on an injected
@@ -495,7 +561,8 @@ def test_check_mode_validates_dry_run_schema(dryrun):
                   doc["observability"]["step_profile"]["paths"]["jsonl"],
                   doc["observability"]["fleet_serving"]["paths"]["jsonl"],
                   doc["observability"]["slo_overload"]["paths"]["jsonl"],
-                  doc["observability"]["host_tick"]["paths"]["jsonl"]):
+                  doc["observability"]["host_tick"]["paths"]["jsonl"],
+                  doc["observability"]["trace_replay"]["paths"]["jsonl"]):
         res = json.loads(_run([script, "--check", jsonl]))
         assert res["ok"] and res["errors"] == []
 
