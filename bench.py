@@ -1741,6 +1741,231 @@ def shared_prefix_dryrun(out_dir=None, n_users=4, shared_len=64,
     }
 
 
+def kv_tiering_dryrun(out_dir=None, page=16):
+    """Hermetic ``--dry-run`` host-tier KV spill/restore section: a REAL
+    tiny paged :class:`~flexflow_tpu.serve.kv_paged.PagedKVAllocator` with
+    a :class:`~flexflow_tpu.serve.kv_paged.HostPageTier` attached, driven
+    through the full tier lifecycle on a virtual clock (host bookkeeping
+    over the real buffers, no jitted step):
+
+    * fill: request A prefills + decodes, then is preempted — its mapped
+      pages SPILL to the host tier before the slot releases (the
+      request_manager.preempt order);
+    * pressure: filler requests churn the pool until the prefix index
+      must evict — evicted shared pages DEMOTE to the host tier instead
+      of being forgotten;
+    * readmit-restore vs recompute: A rebinds and restores its spilled
+      pages — the virtual clock charges ``MachineModel.swap_time`` for
+      the transfer vs ``tokens_saved`` prefill steps for the recompute
+      alternative (the same comparison ``price_kv_swap`` makes);
+    * restore-failure fallback: request B's spilled tail page is
+      corrupted in host DRAM; the checksum catches it at restore, the
+      restore degrades to the r9 recompute feed (same fed tokens), and
+      ``kv_restore_failed`` rides a SEPARATE telemetry export so the
+      clean-path JSONL pins ``kv_restore_failures`` materialized at 0.
+
+    Both JSONL exports round-trip ``summarize_jsonl`` == trace_report
+    (``--check`` clean, pinned by tests); the tier counter vocabulary
+    rides ``summary["tier"]["counters"]`` and the host-DRAM occupancy
+    gauges ride ``summary["memory"]["host_tier"]``.
+    """
+    import os
+
+    from flexflow_tpu.obs import Telemetry
+    from flexflow_tpu.obs.report import summarize_jsonl
+    from flexflow_tpu.search.machine_model import TPU_SPECS, MachineModel
+    from flexflow_tpu.serve.kv_paged import HostTierCorruption
+
+    class _AdvClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 1e-6
+            return self.t
+
+        def advance(self, dt):
+            self.t += dt
+
+    clock = _AdvClock()
+    tel = Telemetry(clock=clock)
+    im = build_im(False, layers=2, hidden=64, heads=4, kv=4, inter=128,
+                  vocab=128, max_requests=4, max_seq=128,
+                  kv_page_size=page)
+    kv = im.kv
+    kv.attach_host_tier(64 << 20)  # generous: no tier evictions here
+    mm = MachineModel(TPU_SPECS["cpu"])
+    tok_s = 1e-3  # virtual prefill seconds per fed token
+
+    rng = np.random.RandomState(0)
+    prompt_a = [int(x) for x in rng.randint(1, 127, size=80)]
+    decode_n = 8
+    gen_a = [int(x) for x in rng.randint(1, 127, size=decode_n)]
+    tid_a = "t00000"
+
+    # fill: A prefills, decodes, then is preempted (spill BEFORE release
+    # — the request_manager.preempt order)
+    tel.request_enqueued(tid_a, prompt_len=len(prompt_a))
+    tel.request_admitted(tid_a, queue_wait_s=0.0)
+    kv.bind(0, slot=0, tokens=prompt_a, need=len(prompt_a) + decode_n)
+    tel.request_prefill_started(tid_a)
+    kv.prepare_write(0, 0, len(prompt_a))
+    clock.advance(len(prompt_a) * tok_s)
+    tel.request_first_token(tid_a, ttft_s=len(prompt_a) * tok_s)
+    kv.prepare_write(0, len(prompt_a), len(prompt_a) + decode_n)
+    kv.observe({0: len(prompt_a) + decode_n}, tel)
+    toks_a = prompt_a + gen_a
+    spill_info = kv.spill(0, toks_a) or {}
+    clock.advance(mm.swap_time(spill_info.get("nbytes", 0)))
+    tel.kv_spilled(tid_a, pages=spill_info.get("pages", 0),
+                   nbytes=spill_info.get("nbytes", 0),
+                   tokens=spill_info.get("tokens", 0))
+    tel.request_preempted(tid_a, recompute_tokens=len(toks_a))
+    kv.release(0)
+
+    # pressure: distinct-prompt fillers churn the pool until the prefix
+    # index must evict — eviction DEMOTES shared pages to the host tier
+    spilled0 = kv.pages_spilled
+    fillers = 0
+    for i in range(12):
+        fid = 100 + i
+        fprompt = [int(x) for x in rng.randint(1, 127, size=112)]
+        kv.bind(fid, slot=i % im.max_requests, tokens=fprompt,
+                need=len(fprompt))
+        kv.prepare_write(fid, 0, len(fprompt))
+        clock.advance(len(fprompt) * tok_s)
+        kv.release(fid)
+        fillers += 1
+        if kv.pages_spilled > spilled0:  # demotion observed: enough churn
+            break
+    demoted_pages = kv.pages_spilled - spilled0
+    kv.observe({}, tel)  # publish the host-tier occupancy gauges
+
+    # readmit-restore: rebind covers whatever the prefix index still
+    # holds; restore resumes the rest from the spill (vs re-prefilling)
+    info_a = kv.bind(0, slot=0, tokens=toks_a,
+                     need=len(toks_a) + decode_n) or {}
+    cached_a = int(info_a.get("cached_tokens", 0))
+    restore_info = kv.restore(0) or {}
+    restored = int(restore_info.get("restored_tokens", 0))
+    saved = int(restore_info.get("tokens_saved", 0))
+    restore_s = mm.swap_time(restore_info.get("nbytes", 0))
+    recompute_s = saved * tok_s
+    clock.advance(restore_s)
+    if restored:
+        tel.kv_restored(tid_a, pages=restore_info.get("pages", 0),
+                        nbytes=restore_info.get("nbytes", 0),
+                        tokens_resumed=restored, tokens_saved=saved)
+    # the unspilled tail (the last token) recomputes as usual
+    fed_tail = len(toks_a) - max(restored, cached_a)
+    kv.prepare_write(0, max(restored, cached_a), len(toks_a))
+    clock.advance(fed_tail * tok_s)
+    kv.observe({0: len(toks_a)}, tel)
+    tel.request_finished(tid_a, n_tokens=decode_n, tpot_s=tok_s,
+                         kv_bytes=kv.release(0))
+    tier_snap = dict(kv.host_tier.snapshot())
+
+    out_dir = out_dir or os.path.join("artifacts", "telemetry")
+    paths = tel.export(out_dir, prefix="dryrun_kv_tiering")
+    summary = summarize_jsonl(paths["jsonl"])
+
+    # restore-failure fallback, on its OWN export: the clean-path JSONL
+    # above must pin kv_restore_failures == 0 (materialized), while this
+    # one shows the checksum catching host-DRAM corruption and the
+    # restore degrading to the recompute feed — same fed tokens, so the
+    # output stream is bit-identical by the r9 contract
+    telf = Telemetry(clock=clock)
+    tid_b = "t00001"
+    prompt_b = [int(x) for x in rng.randint(1, 127, size=40)]
+    telf.request_enqueued(tid_b, prompt_len=len(prompt_b))
+    telf.request_admitted(tid_b, queue_wait_s=0.0)
+    kv.bind(1, slot=1, tokens=prompt_b, need=len(prompt_b) + 2)
+    kv.prepare_write(1, 0, len(prompt_b))
+    clock.advance(len(prompt_b) * tok_s)
+    sp_b = kv.spill(1, list(prompt_b)) or {}
+    telf.kv_spilled(tid_b, pages=sp_b.get("pages", 0),
+                    nbytes=sp_b.get("nbytes", 0),
+                    tokens=sp_b.get("tokens", 0))
+    telf.request_preempted(tid_b, recompute_tokens=len(prompt_b))
+    kv.release(1)
+    kv.host_tier._spills[1].pages[-1].corrupt_for_test()
+    # churn B's pages out of the prefix index (a rebind that prefix-hits
+    # its own just-released pages never needs the spill — the corrupt
+    # tail must be in the restore's verified range to be caught)
+    for i in range(8):
+        fid = 200 + i
+        fprompt = [int(x) for x in rng.randint(1, 127, size=112)]
+        kv.bind(fid, slot=i % im.max_requests, tokens=fprompt,
+                need=len(fprompt))
+        kv.prepare_write(fid, 0, len(fprompt))
+        kv.release(fid)
+    info_b = kv.bind(1, slot=1, tokens=list(prompt_b),
+                     need=len(prompt_b) + 2) or {}
+    cached_b = int(info_b.get("cached_tokens", 0))
+    failure_reason = None
+    try:
+        kv.restore(1)
+    except HostTierCorruption as e:
+        failure_reason = str(e)[:80]
+        kv.drop_spill(1)
+        telf.kv_restore_failed(tid_b, reason=failure_reason)
+    # fallback: the r9 recompute feed — re-prefill the unrestored tokens
+    fallback_fed = len(prompt_b) - cached_b
+    telf.request_prefill_started(tid_b)
+    kv.prepare_write(1, cached_b, len(prompt_b))
+    clock.advance(fallback_fed * tok_s)
+    telf.request_first_token(tid_b, ttft_s=fallback_fed * tok_s)
+    kv.observe({1: len(prompt_b)}, telf)
+    telf.request_finished(tid_b, n_tokens=2, tpot_s=tok_s,
+                          kv_bytes=kv.release(1))
+    paths_f = telf.export(out_dir, prefix="dryrun_kv_tiering_fallback")
+    summary_f = summarize_jsonl(paths_f["jsonl"])
+
+    return {
+        "paths": paths,
+        "fallback_paths": paths_f,
+        "tier": summary["tier"],
+        "host_tier_gauges": summary["memory"].get("host_tier"),
+        "fallback_tier": summary_f["tier"],
+        "page_size": page,
+        "prompt_len": len(prompt_a),
+        "decoded": decode_n,
+        "spill": {"pages": spill_info.get("pages", 0),
+                  "nbytes": spill_info.get("nbytes", 0)},
+        "pressure_fillers": fillers,
+        "demoted_pages": demoted_pages,
+        "rebind_cached_tokens": cached_a,
+        "restored_tokens": restored,
+        "recompute_tokens_saved": saved,
+        "recomputed_tail_tokens": fed_tail,
+        # the planner's comparison, executed: one swap transfer vs
+        # re-prefilling the saved tokens on the virtual clock
+        "restore_s": round(restore_s, 6),
+        "recompute_s": round(recompute_s, 6),
+        "restore_speedup": (round(recompute_s / restore_s, 4)
+                            if restore_s else None),
+        "fallback": {
+            "corruption_detected": failure_reason is not None,
+            "reason": failure_reason,
+            "cached_tokens": cached_b,
+            "fallback_fed_tokens": fallback_fed,
+            # same fed prefix => bit-identical stream (r9 contract,
+            # pinned by tests/test_kv_tiered.py on a real model)
+            "fed_tokens_match_prompt": fallback_fed + cached_b
+            == len(prompt_b),
+        },
+        "host_tier_final": tier_snap,
+        "leak_free": not kv.attributed_rids()
+        and not kv.host_tier._spills,
+        "note": "real tiny paged allocator + HostPageTier (host "
+                "bookkeeping, no jitted step): preempt-spill / "
+                "pressure-demote / readmit-restore vs recompute on a "
+                "virtual clock; the corrupted-restore fallback rides a "
+                "separate export so the clean path pins "
+                "kv_restore_failures == 0",
+    }
+
+
 def spec_serving_dryrun(out_dir=None):
     """Hermetic ``--dry-run`` speculative-serving section: the
     acceptance-aware planning decision end to end on a virtual clock — no
@@ -2761,6 +2986,7 @@ def main(argv=None):
         doc["observability"]["slo_overload"] = slo_overload_dryrun(args.out)
         doc["observability"]["host_tick"] = host_tick_dryrun(args.out)
         doc["observability"]["trace_replay"] = trace_replay_dryrun(args.out)
+        doc["observability"]["kv_tiering"] = kv_tiering_dryrun(args.out)
         print(json.dumps(doc))
         return
 

@@ -63,6 +63,20 @@ PAGED_GAUGE_KEYS = {
 }
 PAGED_GAUGES = tuple(PAGED_GAUGE_KEYS)
 
+# the host-tier extension (serve/kv_paged.py HostPageTier): host-DRAM
+# occupancy gauges, published by Telemetry.kv_usage only when the
+# snapshot carries the host vocabulary (an allocator with no tier
+# attached never emits zeros for a pool it doesn't have).  Same
+# one-table contract as MEMORY_GAUGE_KEYS.
+HOST_TIER_GAUGE_KEYS = {
+    "kv_host_pages": "host_pages",
+    "kv_host_bytes": "host_bytes",
+    "kv_host_capacity_bytes": "host_capacity_bytes",
+    "kv_host_spilled_requests": "host_spilled_requests",
+    "kv_host_evictions": "host_evictions",
+}
+HOST_TIER_GAUGES = tuple(HOST_TIER_GAUGE_KEYS)
+
 # the occupancy distribution (p50/p95 in the report) rides a histogram
 # under this registry name
 KV_OCCUPANCY_HIST = "kv_occupancy"

@@ -299,6 +299,21 @@ class PlanHealthMonitor:
                 "capacity_bytes": round(cap_b, 1),
                 "projected_frac": round(proj_frac, 4),
             }
+            # host-tier occupancy view (serve/kv_paged.py HostPageTier):
+            # spilled pages waiting off-device are recoverable state the
+            # projection above doesn't count (restores re-enter via the
+            # page pool's own admission) — surfaced so the report shows
+            # how much of the deployment's KV is parked in host DRAM
+            tiers = [kv.host_tier for kv in kvs
+                     if getattr(kv, "host_tier", None) is not None]
+            if tiers:
+                report["memory"]["host_tier"] = {
+                    "bytes": sum(t.bytes_used for t in tiers),
+                    "capacity_bytes": sum(t.capacity_bytes for t in tiers),
+                    "pages": sum(t.pages_held() for t in tiers),
+                    "spilled_requests": sum(len(t._spills) for t in tiers),
+                    "evictions": sum(t.evictions for t in tiers),
+                }
             if tel.enabled:
                 tel.metrics.gauge("kv_projected_frac").set(proj_frac)
             if cap_b and proj_frac > cfg.memory_pressure_frac:

@@ -64,6 +64,11 @@ _LANE_SHED = "lane_shed"
 # request, and must not inflate the request count.
 _REPLAY_EVENTS = ("trace_recorded", "replay_started", "replay_completed",
                   "replay_mismatch")
+# host-tier KV spill/restore (serve/kv_paged.py HostPageTier).  All three
+# carry a trace_id, so — like replay_mismatch — they MUST be intercepted
+# before the per-request trace_id branch: a spill instant is about an
+# already-tracked request's pages, and must not inflate the request count.
+_TIER_EVENTS = ("kv_spill", "kv_restore", "kv_restore_failed")
 
 
 def _pct_ms(xs: List[float], q: float) -> Optional[float]:
@@ -96,6 +101,7 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
     brownout_changes: List[Dict] = []
     lane_sheds: List[Dict] = []
     replay_events: Dict[str, List[Dict]] = {n: [] for n in _REPLAY_EVENTS}
+    tier_events: Dict[str, List[Dict]] = {n: [] for n in _TIER_EVENTS}
     for ev in events:
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
@@ -148,6 +154,9 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             continue
         if name in replay_events:
             replay_events[name].append(ev.get("args", {}))
+            continue
+        if name in tier_events:
+            tier_events[name].append(ev.get("args", {}))
             continue
         args = ev.get("args", {})
         trace_id = args.get("trace_id")
@@ -243,6 +252,13 @@ def summarize_events(events: Sequence[Dict]) -> Dict:
             "completed": replay_events["replay_completed"],
             "mismatches": replay_events["replay_mismatch"],
         },
+        # host-tier KV spill/restore (serve/kv_paged.py): per-request
+        # swap instants + restore-degraded-to-recompute fallbacks
+        "tier": {
+            "spills": tier_events["kv_spill"],
+            "restores": tier_events["kv_restore"],
+            "restore_failures": tier_events["kv_restore_failed"],
+        },
     }
 
 
@@ -336,6 +352,14 @@ def summarize_jsonl(path: str) -> Dict:
 
     summary["replay"]["counters"] = {
         k: metrics[k] for k in REPLAY_COUNTERS if k in metrics}
+    # host-tier view: the swap events summarize_events collected + the
+    # exact registry counters (TIER_COUNTERS — kv_restore_failures joins
+    # bench_compare's exact class at threshold zero: a clean-path restore
+    # must never degrade to recompute)
+    from .telemetry import TIER_COUNTERS
+
+    summary["tier"]["counters"] = {
+        k: metrics[k] for k in TIER_COUNTERS if k in metrics}
     # trace-drop hardening: surface the ring buffer's dropped-event
     # count under the exact-class regression counter name, so every
     # bench section that embeds a summary carries it into bench_compare
@@ -405,7 +429,8 @@ def memory_section(memory: Dict, metrics: Dict) -> Dict:
     vocabulary.  Shared by ``bench.py --dry-run``'s ``memory_ledger``
     section and the trace-report CLI (one accounting, two consumers).
     """
-    from .memory import KV_OCCUPANCY_HIST, MEMORY_GAUGES, PAGED_GAUGES
+    from .memory import (HOST_TIER_GAUGES, KV_OCCUPANCY_HIST, MEMORY_GAUGES,
+                         PAGED_GAUGES)
 
     occ = metrics.get(KV_OCCUPANCY_HIST) or {}
     section: Dict = {
@@ -425,6 +450,11 @@ def memory_section(memory: Dict, metrics: Dict) -> Dict:
             k: metrics[k] for k in ("prefix_hits", "prefix_misses",
                                     "prefix_tokens_reused")
             if k in metrics}
+    # host-tier view (serve/kv_paged.py HostPageTier): host-DRAM
+    # occupancy gauges — present only when a tier was attached
+    host = {g: metrics[g] for g in HOST_TIER_GAUGES if g in metrics}
+    if host:
+        section["host_tier"] = host
     alloc_err: Dict[str, Dict] = {}
     for plan, fields in memory.get("plans", {}).items():
         alloc_err[plan] = {
@@ -529,7 +559,7 @@ def validate_jsonl(path: str) -> List[str]:
         # typed vocabulary: the categories the report parses semantically
         cat = doc.get("cat")
         if ph == "i" and cat in ("request", "dispatch", "plan", "profile",
-                                 "fleet", "slo", "replay"):
+                                 "fleet", "slo", "replay", "tier"):
             name = doc["name"]
             schema = EVENT_SCHEMA.get(name)
             if schema is None:

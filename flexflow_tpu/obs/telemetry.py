@@ -126,6 +126,17 @@ EVENT_SCHEMA = {
     "replay_started": ("replay", ("mode",)),
     "replay_completed": ("replay", ("mode",)),
     "replay_mismatch": ("replay", ("trace_id", "field")),
+    # host-tier KV spill/restore (serve/kv_paged.py HostPageTier): one
+    # request's mapped pages moved off device (kv_spill — preemption /
+    # page-pressure / brownout SPILL), moved back at readmission
+    # (kv_restore — tokens_resumed is the write frontier the decode
+    # resumes at, tokens_saved the prefill recompute avoided), or a
+    # restore degraded to the r9 recompute feed (kv_restore_failed —
+    # checksum corruption or swap-in retry exhaustion; never corruption)
+    "kv_spill": ("tier", ("trace_id", "pages", "nbytes", "tokens")),
+    "kv_restore": ("tier", ("trace_id", "pages", "nbytes",
+                            "tokens_resumed", "tokens_saved")),
+    "kv_restore_failed": ("tier", ("trace_id", "reason")),
 }
 
 # migration counter/gauge vocabulary (report.py folds these into the
@@ -219,6 +230,26 @@ REPLAY_REGRESSION_COUNTERS = (
 # report.py stamps it into every summary from the telemetry_meta line.
 TRACE_REGRESSION_COUNTERS = (
     "telemetry_events_dropped",
+)
+
+# Host-tier KV spill/restore counter vocabulary (serve/kv_paged.py;
+# report.py folds these into the ``tier`` summary section — one tuple
+# shared by the emitters, the report, and the bench ``kv_tiering``
+# dry-run).  All exact cumulative counters on the seeded virtual clock.
+TIER_COUNTERS = (
+    "kv_pages_spilled", "kv_pages_restored", "kv_swap_bytes",
+    "kv_restore_failures", "recompute_tokens_saved",
+)
+
+# the monotone bad-if-increasing subset joining bench_compare's exact
+# class: a restore failure means a checksum-verified swap-in degraded to
+# recompute — correct but strictly worse, so the clean-path threshold is
+# exactly zero (kv_spilled/kv_restored materialize it at 0 so a healthy
+# baseline exports the field and the guard arms).  The volume counters
+# stay out: more spills for the same workload can mean better brownout
+# behavior, not worse — direction is not monotone.
+TIER_REGRESSION_COUNTERS = (
+    "kv_restore_failures",
 )
 
 
@@ -593,6 +624,47 @@ class Telemetry:
         return self.trace.instant("replay_mismatch", "replay", "replay",
                                   trace_id=trace_id, field=field)
 
+    # ---- host-tier KV spill/restore (serve/kv_paged.py) ----------------
+    def kv_spilled(self, trace_id: str, pages: int = 0, nbytes: int = 0,
+                   tokens: int = 0) -> float:
+        """One request's mapped KV pages moved to the host tier
+        (preemption, page pressure, or the brownout SPILL action)."""
+        m = self.metrics
+        m.counter("kv_pages_spilled").inc(pages)
+        m.counter("kv_swap_bytes").inc(nbytes)
+        # materialize the failure counter at 0 on the clean path: the
+        # exact-class guard only fires when the reference artifact
+        # carries the field, so a healthy baseline must export it
+        m.counter("kv_restore_failures").inc(0)
+        return self.trace.instant("kv_spill", "tier", "tier",
+                                  trace_id=trace_id, pages=pages,
+                                  nbytes=nbytes, tokens=tokens)
+
+    def kv_restored(self, trace_id: str, pages: int = 0, nbytes: int = 0,
+                    tokens_resumed: int = 0, tokens_saved: int = 0) -> float:
+        """A readmitted request's pages came back from the host tier —
+        ``tokens_resumed`` is the restored write frontier, ``tokens_saved``
+        the prefill recompute the restore avoided."""
+        m = self.metrics
+        m.counter("kv_pages_restored").inc(pages)
+        m.counter("kv_swap_bytes").inc(nbytes)
+        m.counter("recompute_tokens_saved").inc(tokens_saved)
+        m.counter("kv_restore_failures").inc(0)
+        return self.trace.instant("kv_restore", "tier", "tier",
+                                  trace_id=trace_id, pages=pages,
+                                  nbytes=nbytes,
+                                  tokens_resumed=tokens_resumed,
+                                  tokens_saved=tokens_saved)
+
+    def kv_restore_failed(self, trace_id: str, reason: str = "") -> float:
+        """One restore degraded to the r9 recompute feed (checksum
+        corruption or swap-in retry exhaustion).  Exact-class regression
+        counter — any increase on a clean-path workload fails
+        bench_compare."""
+        self.metrics.counter("kv_restore_failures").inc()
+        return self.trace.instant("kv_restore_failed", "tier", "tier",
+                                  trace_id=trace_id, reason=reason)
+
     def spec_batch_mix(self, spec_requests: int, plain_requests: int) -> None:
         """One mixed verify macro-step's request composition: how many
         rows shipped a draft tree (multi-token verify) vs a root-only
@@ -643,6 +715,11 @@ class Telemetry:
             from .memory import PAGED_GAUGE_KEYS
 
             for gauge, key in PAGED_GAUGE_KEYS.items():
+                m.gauge(gauge).set(snap.get(key, 0.0))
+        if "host_pages" in snap:  # host tier attached: occupancy view
+            from .memory import HOST_TIER_GAUGE_KEYS
+
+            for gauge, key in HOST_TIER_GAUGE_KEYS.items():
                 m.gauge(gauge).set(snap.get(key, 0.0))
         m.histogram(KV_OCCUPANCY_HIST).observe(occ)
         self.trace.counter("kv_occupancy_frac", occ)
@@ -846,6 +923,15 @@ class NullTelemetry:
         return 0.0
 
     def replay_mismatch(self, *a, **k):
+        return 0.0
+
+    def kv_spilled(self, *a, **k):
+        return 0.0
+
+    def kv_restored(self, *a, **k):
+        return 0.0
+
+    def kv_restore_failed(self, *a, **k):
         return 0.0
 
     def spec_batch_mix(self, *a, **k):

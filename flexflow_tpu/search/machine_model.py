@@ -34,6 +34,12 @@ class TPUSpec:
     step_overhead: float = 3e-6     # per compiled-step dispatch/loop overhead
     train_step_factor: float = 3.0  # whole train step time / forward time
     overlap: float = 0.3            # comm fraction hidden behind compute
+    # host-tier KV swap (serve/kv_paged.py): device<->host-DRAM link the
+    # spill/restore transfers ride (PCIe-class; TPU hosts see ~8-32 GB/s
+    # effective).  Defaults here so every spec entry prices swaps without
+    # per-generation numbers; calibratable like every constant.
+    host_bandwidth: float = 12.5e9  # bytes/s, device<->host
+    host_latency: float = 20e-6     # per-transfer setup
     # speculative serving (serve/spec_infer.py): the draft-token acceptance
     # rate at which one speculative macro-step (depth draft levels + one
     # tree-verify pass) costs the same PER TOKEN as incremental decoding —
@@ -118,7 +124,8 @@ class MachineModel:
             return self
         fields = ("mxu_efficiency", "vmem_resident_bytes", "step_overhead",
                   "train_step_factor", "overlap",
-                  "spec_break_even_acceptance")
+                  "spec_break_even_acceptance",
+                  "host_bandwidth", "host_latency")
         spec = dataclasses.replace(
             self.spec,
             **{k: float(doc[k]) for k in fields if k in doc},
@@ -131,7 +138,7 @@ class MachineModel:
     # constants divide by it
     _TIME_CONSTANTS = frozenset({
         "step_overhead", "kernel_overhead", "ici_latency", "dcn_latency",
-        "train_step_factor",
+        "host_latency", "train_step_factor",
         # relatively slower verify/draft steps raise the acceptance needed
         # to break even — time-like (multiplies by the measured/predicted
         # ratio), so a CalibrationStore component named after it scales
@@ -140,7 +147,8 @@ class MachineModel:
     })
     _RATE_CONSTANTS = frozenset({
         "hbm_bandwidth", "ici_bandwidth", "dcn_bandwidth",
-        "peak_flops_bf16", "peak_flops_f32", "mxu_efficiency",
+        "host_bandwidth", "peak_flops_bf16", "peak_flops_f32",
+        "mxu_efficiency",
     })
 
     def with_store(self, store) -> "MachineModel":
@@ -196,6 +204,15 @@ class MachineModel:
         bw = self.spec.dcn_bandwidth if on_dcn else self.spec.ici_bandwidth
         lat = self.spec.dcn_latency if on_dcn else self.spec.ici_latency
         return nbytes / bw + lat
+
+    def swap_time(self, nbytes: float) -> float:
+        """Device<->host-DRAM transfer time for one KV spill or restore
+        (serve/kv_paged.py HostPageTier).  The planner compares this
+        against recompute-prefill cost (``serve_search.price_kv_swap``)
+        to decide, per workload, whether a host tier pays off."""
+        if nbytes <= 0:
+            return 0.0
+        return nbytes / self.spec.host_bandwidth + self.spec.host_latency
 
     def collective_time(self, comm_bytes_per_device: float, axes, mesh) -> float:
         """Ring-model time for a collective moving ``comm_bytes_per_device``

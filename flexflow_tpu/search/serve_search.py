@@ -410,6 +410,70 @@ def _workload_knobs(feats: Optional[Dict], max_seq,
     return knobs
 
 
+# committed-cache storage bytes per element (serve/ops.py kv_dtype); int8
+# carries float32 scale planes priced separately in _kv_token_bytes
+_KV_DTYPE_BYTES = {"int8": 1, "bfloat16": 2, "float16": 2, "float32": 4}
+
+
+def _kv_token_bytes(graph) -> int:
+    """Committed-KV bytes ONE token occupies across every attention layer
+    — the unit the host-tier swap pricing (:func:`price_kv_swap`) scales
+    by.  Analytic per-op: K + V vectors (``2 * num_kv_heads * head_dim``)
+    at the committed-cache dtype, plus the float32 scale planes an int8
+    cache pages alongside its values (``2 * num_kv_heads * 4`` — the
+    [rows, KV, S] k_scale/v_scale buffers of serve/kv_paged.py)."""
+    from ..serve.ops import IncMultiHeadSelfAttention
+
+    total = 0
+    for node in graph.nodes:
+        op = node.op
+        if not isinstance(op, IncMultiHeadSelfAttention):
+            continue
+        dt = str(op.kv_dtype or getattr(op, "dtype", None) or "float32")
+        total += 2 * op.num_kv_heads * op.head_dim * _KV_DTYPE_BYTES.get(dt, 4)
+        if dt == "int8":
+            total += 2 * op.num_kv_heads * 4
+    return total
+
+
+def price_kv_swap(machine: MachineModel, kv_bytes_per_token: float,
+                  tokens: float, prefill_s_per_token: float) -> Dict:
+    """Price restoring ``tokens`` of spilled KV from the host tier
+    (serve/kv_paged.py ``HostPageTier``) against recomputing them through
+    prefill — the planner's spill-vs-recompute decision, made with the
+    same :class:`MachineModel` constants everything else prices with.
+
+    * restore: one device<->host transfer of the request's committed
+      pages (:meth:`MachineModel.swap_time` — ``host_bandwidth`` /
+      ``host_latency``);
+    * recompute: re-feeding the same tokens through prefill at the
+      plan's achieved prefill rate (``prefill_s_per_token`` — derived
+      from the priced TTFT, so it embeds the plan's tp/pp shape).
+
+    ``break_even_tokens``: the resume depth above which restoring wins —
+    ``host_latency / (prefill_s_per_token - per_token_swap_s)``; None
+    when recompute is cheaper per token at ANY depth (swap link slower
+    than prefill), in which case ``prefer_restore`` is False and the
+    deployment should skip attaching a host tier for this workload.
+    """
+    tokens = max(float(tokens), 0.0)
+    nbytes = float(kv_bytes_per_token) * tokens
+    restore_s = machine.swap_time(nbytes)
+    recompute_s = float(prefill_s_per_token) * tokens
+    per_tok_swap = float(kv_bytes_per_token) / machine.spec.host_bandwidth
+    margin = float(prefill_s_per_token) - per_tok_swap
+    break_even = machine.spec.host_latency / margin if margin > 0 else None
+    return {
+        "tokens": int(round(tokens)),
+        "swap_bytes": int(round(nbytes)),
+        "restore_ms": round(restore_s * 1e3, 4),
+        "recompute_ms": round(recompute_s * 1e3, 4),
+        "break_even_tokens": (round(break_even, 1)
+                              if break_even is not None else None),
+        "prefer_restore": bool(restore_s < recompute_s),
+    }
+
+
 def _graph_rows(graph, attn_node) -> int:
     """The flat token-batch rows the serve graph was built for
     (``max_tokens_per_batch``): the attention input's leading dim."""
@@ -710,6 +774,23 @@ def search_serve_plan(
         best["workload"] = feats
     if kv_page_size:
         best["kv_page_size"] = int(kv_page_size)
+        # host-tier spill/restore vs recompute, priced at the winning
+        # plan's achieved prefill rate (TTFT / unshared prompt — the same
+        # discounted prompt the TTFT was priced over) for the mean live
+        # depth a readmitted request resumes at (prompt + half the
+        # output, _workload_knobs' depth).  Needs workload features AND a
+        # priced TTFT; without either the deployment has no rate to
+        # compare the swap link against.
+        tok_bytes = _kv_token_bytes(graph)
+        if (feats and tok_bytes and prompt_len > 0
+                and best.get("ttft_ms") is not None):
+            mesh = make_mesh({"tp": best["tp"]}, devices[:best["tp"]])
+            mm = machine or MachineModel.for_mesh(mesh, spec_name=spec_name)
+            if store is not None:
+                mm = mm.with_store(store)
+            best["kv_swap"] = price_kv_swap(
+                mm, tok_bytes, prompt_len + 0.5 * out_len,
+                (best["ttft_ms"] / 1e3) / prompt_len)
     if store is not None:
         best["applied_scales"] = store.scales()
     if telemetry is not None and getattr(telemetry, "enabled", False):
@@ -801,7 +882,7 @@ def price_plan(
     knobs = _workload_knobs(feats,
                             getattr(attn0.op, "cost_seq_len", None),
                             kv_page_size)
-    knobs.pop("out_len")  # pricing knob only for the ranking objective
+    out_len = knobs.pop("out_len")  # ranking/swap knob, not a cost input
     cost = pp_serve_cost(
         plans, mm, n_micro=n_micro,
         boundary_bytes=_boundary_bytes(graph, split),
@@ -824,4 +905,14 @@ def price_plan(
     cost["transfer_ms"] = round(cost["transfer_s"] * 1e3, 5)
     if cost["ttft_s"] is not None:
         cost["ttft_ms"] = round(cost["ttft_s"] * 1e3, 4)
+    # host-tier swap pricing on the TRUE machine — same derivation as the
+    # chooser's best["kv_swap"], so replayed restore-vs-recompute pairs
+    # compare like against like
+    if kv_page_size:
+        tok_bytes = _kv_token_bytes(graph)
+        if (feats and tok_bytes and knobs["prompt_len"] > 0
+                and cost["ttft_s"] is not None):
+            cost["kv_swap"] = price_kv_swap(
+                mm, tok_bytes, knobs["prompt_len"] + 0.5 * out_len,
+                cost["ttft_s"] / knobs["prompt_len"])
     return cost

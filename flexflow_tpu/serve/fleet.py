@@ -661,8 +661,21 @@ class FleetRouter:
         self._enqueue_tick.pop(rid, None)
         rep.dispatched += 1
         frm = self._failover_from.pop(rid, None)
-        if frm is not None and self.telemetry.enabled:
-            self.telemetry.request_failed_over(req.trace_id, frm, rep.name)
+        if frm is not None:
+            # host-tier KV failover: the reclaim's preempt spilled this
+            # request's pages into the FAILED replica's host tier (which
+            # survives its KV teardown — host copies stay valid, KV is a
+            # pure function of the fed tokens).  Adopt them onto the
+            # survivor so readmission restores instead of re-prefilling;
+            # a shape-mismatched survivor (adopt_spills signature check)
+            # falls back to the recompute feed the reclaim preserved.
+            src_rm = self._by_name(frm).rm
+            for src_kv, dst_kv in zip(_allocators(src_rm),
+                                      _allocators(rm)):
+                dst_kv.adopt_spills(src_kv, [rid])
+            if self.telemetry.enabled:
+                self.telemetry.request_failed_over(req.trace_id, frm,
+                                                   rep.name)
 
     def _dispatch_queue(self) -> None:
         if not self.queue:
@@ -1160,6 +1173,31 @@ class FleetRouter:
         if tel.enabled:
             for cname, cnt in deferred.items():
                 tel.lane_deferred(cname, count=cnt)
+        # --- SPILL: the rung between DEFER and DEGRADE -----------------
+        # on pressured replicas with a host tier attached, push
+        # degradable decoding requests' pages to host DRAM (each
+        # preempt() spills first) BEFORE any capping or shedding below —
+        # readmission restores them, so this rung only trades latency
+        # for headroom, never tokens.  An ACTION of DEFER_BATCH and
+        # above, not a ladder level — the `bo.level < 2` gate right
+        # after stays the untouched DEGRADE boundary.
+        frac = bo.config.kv_pressure_frac
+        for rep in alive:
+            rm = rep.rm
+            kv = getattr(rm.im, "kv", None)
+            if kv is None or kv.host_tier is None or not kv.capacity_tokens:
+                continue
+            if kv.live_tokens() / kv.capacity_tokens < frac:
+                continue
+            victims = [r for r in rm._active()
+                       if r.status is RequestStatus.DECODING
+                       and bo.spills(r.slo_class)
+                       and r.preemptions < rm.res.max_preemptions]
+            victims.sort(key=lambda r: (r.priority, -r.rid))
+            for req in victims:
+                if kv.live_tokens() / kv.capacity_tokens < frac:
+                    break
+                rm.preempt(req.rid)
         if bo.level < 2:  # below DEGRADE_BATCH: nothing touches live work
             return
         for rep in alive:

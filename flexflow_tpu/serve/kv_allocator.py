@@ -211,6 +211,10 @@ class KVAllocator:
     # page-granular hooks below behind the SAME bind/observe/release/
     # bytes_per_token/capacity_bytes interface.
     paged = False
+    # host-DRAM spill tier (serve/kv_paged.py HostPageTier); the
+    # slot-contiguous allocator never tiers — None keeps every caller's
+    # ``kv.host_tier is not None`` gate uniform across allocator kinds.
+    host_tier = None
 
     def __init__(self, stages: Sequence[StageKV], max_requests: int,
                  max_seq_len: int):
@@ -310,6 +314,30 @@ class KVAllocator:
         """The device-side block-table pytree for the jitted step (None =
         slot-contiguous addressing; see kv_paged.PageTable)."""
         return None
+
+    # ---- host-tier hooks (no-ops: only the paged subclass tiers) ------
+    def attach_host_tier(self, capacity_bytes: int):
+        """Attach a bounded host-DRAM spill tier.  The slot-contiguous
+        allocator has no page granularity to spill at — recovery stays
+        recompute-based — so this is a no-op returning None; callers
+        (RequestManager, migration, fleet) gate every swap path on
+        ``host_tier is not None`` and need no isinstance checks."""
+        return None
+
+    def spill(self, rid: int, tokens) -> Optional[Dict]:
+        return None
+
+    def restore(self, rid: int, align: int = 1) -> Optional[Dict]:
+        return None
+
+    def has_spill(self, rid: int) -> bool:
+        return False
+
+    def drop_spill(self, rid: int) -> None:
+        return None
+
+    def adopt_spills(self, other, rids) -> int:
+        return 0
 
     def observe(self, usage: Dict[int, int], telemetry=None) -> Dict:
         """One serve tick's live cache depths (``rid -> tokens`` for every

@@ -70,6 +70,7 @@ into a jitted program.
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -83,6 +84,14 @@ class PagePoolExhausted(RuntimeError):
     RequestManager._kv_prepare turns this into page-pressure preemption
     when ``ResilienceConfig.preemption`` is on; otherwise it propagates
     (an admission gate sized with ``round_need`` prevents it)."""
+
+
+class HostTierCorruption(RuntimeError):
+    """A host-tier page failed its checksum on restore.  NOT retryable
+    (the host copy itself is damaged): the caller drops the entry and
+    falls back to the r9 recompute feed, which is bit-identical by
+    construction — swap is an optimization the correctness contract
+    never depends on."""
 
 
 @jax.tree_util.register_dataclass
@@ -113,6 +122,180 @@ class _Entry:
         self.pid = pid
         self.lru = lru
         self.tokens = tokens
+
+
+class _HostPage:
+    """One page's content copied to host DRAM: the per-buffer blocks in
+    the allocator's deterministic ``_page_blocks`` walk order, plus a
+    CRC32 over all of them.  The checksum is verified on EVERY restore —
+    a corrupt host copy must fall back to recompute, never upload."""
+
+    __slots__ = ("blocks", "crc", "nbytes")
+
+    def __init__(self, blocks: List[np.ndarray], crc: int, nbytes: int):
+        self.blocks = blocks
+        self.crc = crc
+        self.nbytes = nbytes
+
+    def verify(self) -> bool:
+        crc = 0
+        for blk in self.blocks:
+            crc = zlib.crc32(np.ascontiguousarray(blk).tobytes(), crc)
+        return crc == self.crc
+
+    def corrupt_for_test(self) -> None:
+        """Flip one byte of the first block WITHOUT updating the checksum
+        (chaos-test hook: a restore must detect this and recompute)."""
+        raw = bytearray(np.ascontiguousarray(self.blocks[0]).tobytes())
+        raw[0] ^= 0xFF
+        self.blocks[0] = np.frombuffer(
+            bytes(raw), dtype=self.blocks[0].dtype
+        ).reshape(self.blocks[0].shape)
+
+
+class _Spill:
+    """One preempted/evicted request's spilled pages: logical pages
+    ``[0, ceil(hi/page_size))`` of its row, the fed-token prefix that
+    produced them (the content-identity witness restore verifies), and
+    the write frontier ``hi`` the restore resumes at."""
+
+    __slots__ = ("pages", "tokens", "hi", "nbytes", "lru")
+
+    def __init__(self, pages: List[_HostPage], tokens: List[int], hi: int):
+        self.pages = pages
+        self.tokens = tokens
+        self.hi = hi
+        self.nbytes = sum(p.nbytes for p in pages)
+        self.lru = 0
+
+
+class _Demoted:
+    """One prefix-index page demoted to the host tier instead of being
+    forgotten at LRU eviction: content + the entry's token identity and
+    protected extent, so a later bind can promote it back as if the
+    index had never evicted it."""
+
+    __slots__ = ("page", "tokens", "protected", "lru")
+
+    def __init__(self, page: _HostPage, tokens: Tuple[int, ...],
+                 protected: int):
+        self.page = page
+        self.tokens = tokens
+        self.protected = protected
+        self.lru = 0
+
+
+class HostPageTier:
+    """Bounded host-DRAM pool under :class:`PagedKVAllocator`: holds
+    spilled request pages (``_Spill`` per rid) and demoted prefix-index
+    pages (``_Demoted`` per index key) with ONE LRU across both kinds.
+
+    Capacity is enforced at admission: storing a unit evicts
+    least-recently-used units until it fits; a unit larger than the
+    whole tier is refused (the caller falls back to recompute — the
+    correctness contract never depends on a store succeeding).  Host
+    numpy only (device pinning is a real-TPU nicety the CPU/test path
+    has no analogue for); nothing here is traced into a jitted program,
+    so attaching a tier can never change serve outputs.
+
+    ``signature`` is the owning allocator's :meth:`PagedKVAllocator.
+    swap_signature` — migration/fleet readmission adopts entries onto a
+    successor allocator only when the signatures match exactly (same
+    page geometry, same per-page buffer shapes/dtypes)."""
+
+    def __init__(self, capacity_bytes: int, signature: Tuple = ()):
+        self.capacity_bytes = int(capacity_bytes)
+        self.signature = signature
+        self.bytes_used = 0
+        self.evictions = 0
+        self._spills: Dict[int, _Spill] = {}
+        self._demoted: Dict[Tuple, _Demoted] = {}
+        self._lru_tick = 0
+
+    def _stamp(self, unit) -> None:
+        self._lru_tick += 1
+        unit.lru = self._lru_tick
+
+    def _unit_bytes(self, unit) -> int:
+        return unit.nbytes if isinstance(unit, _Spill) else unit.page.nbytes
+
+    def _make_room(self, need: int) -> bool:
+        if need > self.capacity_bytes:
+            return False
+        while self.bytes_used + need > self.capacity_bytes:
+            units = [(s.lru, 0, rid) for rid, s in self._spills.items()]
+            units += [(d.lru, 1, key) for key, d in self._demoted.items()]
+            if not units:
+                return False
+            _, kind, key = min(units)
+            if kind == 0:
+                self.drop_spill(key)
+            else:
+                self.drop_demoted(key)
+            self.evictions += 1
+        return True
+
+    # ---- spilled requests --------------------------------------------
+    def put_spill(self, rid: int, spill: _Spill) -> bool:
+        self.drop_spill(rid)
+        if not self._make_room(spill.nbytes):
+            return False
+        self._spills[int(rid)] = spill
+        self.bytes_used += spill.nbytes
+        self._stamp(spill)
+        return True
+
+    def get_spill(self, rid: int) -> Optional[_Spill]:
+        s = self._spills.get(int(rid))
+        if s is not None:
+            self._stamp(s)
+        return s
+
+    def drop_spill(self, rid: int) -> None:
+        s = self._spills.pop(int(rid), None)
+        if s is not None:
+            self.bytes_used -= s.nbytes
+
+    def pop_spill(self, rid: int) -> Optional[_Spill]:
+        s = self._spills.pop(int(rid), None)
+        if s is not None:
+            self.bytes_used -= s.nbytes
+        return s
+
+    # ---- demoted index pages -----------------------------------------
+    def put_demoted(self, key: Tuple, rec: _Demoted) -> bool:
+        self.drop_demoted(key)
+        if not self._make_room(rec.page.nbytes):
+            return False
+        self._demoted[key] = rec
+        self.bytes_used += rec.page.nbytes
+        self._stamp(rec)
+        return True
+
+    def get_demoted(self, key: Tuple) -> Optional[_Demoted]:
+        d = self._demoted.get(key)
+        if d is not None:
+            self._stamp(d)
+        return d
+
+    def drop_demoted(self, key: Tuple) -> None:
+        d = self._demoted.pop(key, None)
+        if d is not None:
+            self.bytes_used -= d.page.nbytes
+
+    # ---- occupancy ----------------------------------------------------
+    def pages_held(self) -> int:
+        return (sum(len(s.pages) for s in self._spills.values())
+                + len(self._demoted))
+
+    def snapshot(self) -> Dict:
+        return {
+            "host_pages": self.pages_held(),
+            "host_bytes": self.bytes_used,
+            "host_capacity_bytes": self.capacity_bytes,
+            "host_spilled_requests": len(self._spills),
+            "host_evictions": self.evictions,
+        }
 
 
 def validate_page_tile(page_size: int, prefill_tile: int) -> None:
@@ -191,6 +374,17 @@ class PagedKVAllocator(KVAllocator):
         # the StepProfiler polls this into its deterministic
         # ``pages_mapped`` work counter (obs/profiler.py)
         self.pages_mapped = 0
+        # host-tier swap counters (cumulative; the tier regression class
+        # in bench_compare).  The tier itself is attached explicitly
+        # (attach_host_tier) and survives allocate()/teardown(): KV at a
+        # position is a pure function of the fed token prefix, so a host
+        # copy stays valid across buffer reallocation.
+        self.host_tier: Optional[HostPageTier] = None
+        self.pages_spilled = 0
+        self.pages_restored = 0
+        self.swap_bytes = 0
+        self.restore_failures = 0
+        self.recompute_tokens_saved = 0
         self._init_pool()
 
     # ------------------------------------------------------------------
@@ -272,6 +466,17 @@ class PagedKVAllocator(KVAllocator):
                 "enable ResilienceConfig.preemption for page-pressure "
                 "eviction)")
         _, key = victims[0]
+        # demote the victim to the host tier before forgetting it: a
+        # later bind matching the same chain promotes it back instead of
+        # re-prefilling.  Full-page entries only — a partial tail is one
+        # sub-page of recompute, not worth a tier slot.
+        if self.host_tier is not None and key[0] == "f":
+            e = self._entries[key]
+            rec = _Demoted(self._read_page(e.pid), e.tokens,
+                           int(self._protected.get(e.pid, self.page_size)))
+            if self.host_tier.put_demoted(key, rec):
+                self.pages_spilled += 1
+                self.swap_bytes += rec.page.nbytes
         self._drop_entry(key)
         self.pages_evicted += 1
         return self._free.pop()
@@ -373,6 +578,12 @@ class PagedKVAllocator(KVAllocator):
         hit_pids: List[int] = []
         for k, h_k in enumerate(hashes):
             e = self._entries.get(("f", h_k))
+            if e is None and self.host_tier is not None:
+                # promotion: a page the index evicted may still sit in
+                # the host tier — checksum-verify and re-register it so
+                # the chain keeps matching (as if never evicted)
+                e = self._promote_full(
+                    ("f", h_k), tuple(toks[k * ps:(k + 1) * ps]))
             if e is None or e.tokens != tuple(toks[k * ps:(k + 1) * ps]):
                 break
             hit_pids.append(e.pid)
@@ -544,6 +755,260 @@ class PagedKVAllocator(KVAllocator):
         self._init_pool()
         return leaked
 
+    # ---- host-tier spill / restore ------------------------------------
+    def attach_host_tier(self, capacity_bytes: int) -> Optional[HostPageTier]:
+        """Attach a bounded host-DRAM tier (``ResilienceConfig.
+        host_tier_bytes``).  Idempotent; 0/negative capacity detaches."""
+        if capacity_bytes and int(capacity_bytes) > 0:
+            if (self.host_tier is None
+                    or self.host_tier.capacity_bytes != int(capacity_bytes)):
+                self.host_tier = HostPageTier(int(capacity_bytes))
+        else:
+            self.host_tier = None
+        return self.host_tier
+
+    def _kv_buffers(self):
+        """Deterministic (stage, node, buffer) walk over every KV plane —
+        ONE ordering shared by spill capture, restore upload, and
+        ``swap_signature``, so a host page's block list lines up with the
+        buffers it re-enters."""
+        for stage in self.stages:
+            state = stage.state
+            if not state:
+                continue
+            for node in sorted(state):
+                bufs = state[node]
+                for name in sorted(n for n in bufs
+                                   if n in KV_BUFFER_NAMES):
+                    yield bufs, name
+
+    def swap_signature(self) -> Tuple:
+        """Page-content compatibility key: page geometry plus every KV
+        buffer's per-page block shape and dtype, in walk order.  Two
+        allocators with equal signatures can exchange host pages
+        (migration/fleet adoption); anything else must recompute."""
+        blocks = tuple(
+            (name, (int(bufs[name].shape[1]),) +
+             tuple(int(d) for d in bufs[name].shape[3:]),
+             str(bufs[name].dtype))
+            for bufs, name in self._kv_buffers())
+        return (self.page_size, blocks)
+
+    def _read_page(self, pid: int) -> _HostPage:
+        """Device -> host copy of one physical page across every KV
+        buffer, with a chained CRC32 over the raw bytes."""
+        ps = self.page_size
+        r, s = divmod(int(pid), self.pages_per_row)
+        blocks: List[np.ndarray] = []
+        crc, nbytes = 0, 0
+        for bufs, name in self._kv_buffers():
+            arr = bufs[name]
+            tail = (0,) * (arr.ndim - 3)
+            blk = np.asarray(jax.lax.dynamic_slice(
+                arr, (r, 0, s * ps) + tail,
+                (1, arr.shape[1], ps) + arr.shape[3:]))
+            crc = zlib.crc32(np.ascontiguousarray(blk).tobytes(), crc)
+            blocks.append(blk)
+            nbytes += blk.nbytes
+        return _HostPage(blocks, crc, nbytes)
+
+    def _write_page(self, pid: int, page: _HostPage) -> None:
+        """Host -> device upload of one page (inverse of ``_read_page``;
+        the updated arrays re-bind into the stage state dicts exactly
+        like the COW copy)."""
+        ps = self.page_size
+        r, s = divmod(int(pid), self.pages_per_row)
+        it = iter(page.blocks)
+        for bufs, name in self._kv_buffers():
+            arr = bufs[name]
+            tail = (0,) * (arr.ndim - 3)
+            bufs[name] = jax.lax.dynamic_update_slice(
+                arr, next(it), (r, 0, s * ps) + tail)
+
+    def spill(self, rid: int, tokens: Sequence[int]) -> Optional[Dict]:
+        """Copy ``rid``'s written pages to the host tier — called BEFORE
+        the mapping is released (preemption, page-pressure eviction,
+        migration drain, brownout SPILL).  ``tokens`` is the
+        authoritative fed sequence (prompt + generated): the chain's own
+        token list only covers the bind-time feed, not decode-written
+        positions, and restore verifies content identity against it.
+
+        Returns ``{"pages", "nbytes", "tokens"}`` or None when nothing
+        spilled (no tier, nothing written, or the tier refused — in
+        every None case the r9 recompute feed covers recovery)."""
+        tier = self.host_tier
+        if tier is None:
+            return None
+        rid = int(rid)
+        slot = self._slot_of.get(rid)
+        info = self._chain.get(rid)
+        if slot is None or info is None:
+            return None
+        toks = [int(t) for t in tokens]
+        hi = min(int(info["written_hi"]), len(toks))
+        if hi <= 0:
+            return None
+        ps = self.page_size
+        pages: List[_HostPage] = []
+        for k in range(-(-hi // ps)):
+            pid = int(self._table[slot, k])
+            if pid == self.scratch_pid:
+                # unwritten hole (shouldn't happen below written_hi, but
+                # truncate defensively: beyond here is recompute's job)
+                hi = min(hi, k * ps)
+                break
+            pages.append(self._read_page(pid))
+        pages = pages[:-(-hi // ps)] if hi > 0 else []
+        if hi <= 0 or not pages:
+            return None
+        rec = _Spill(pages, toks, int(hi))
+        tier.signature = self.swap_signature()
+        if not tier.put_spill(rid, rec):
+            return None  # larger than the whole tier: pure recompute
+        self.pages_spilled += len(pages)
+        self.swap_bytes += rec.nbytes
+        return {"pages": len(pages), "nbytes": rec.nbytes,
+                "tokens": int(hi)}
+
+    def restore(self, rid: int, align: int = 1) -> Optional[Dict]:
+        """Upload ``rid``'s spilled pages back onto its (re)bound row and
+        advance the write frontier — called right after ``bind`` on
+        readmission, so it only covers the span bind's prefix hits did
+        not already map.  The spill entry is consumed either way.
+
+        Content identity is verified first (the spilled token prefix
+        must equal the new feed's — a stale entry from rid reuse drops
+        silently, it is NOT a failure); every needed page is
+        checksum-verified BEFORE the table mutates, and a corrupt page
+        raises :class:`HostTierCorruption` with the bind result
+        untouched so the caller falls back to recompute bit-identically.
+        Pool exhaustion mid-upload degrades to a partial restore (the
+        tail recomputes).  Returns ``{"restored_tokens", "pages",
+        "nbytes", "tokens_saved"}`` or None."""
+        tier = self.host_tier
+        if tier is None:
+            return None
+        rid = int(rid)
+        slot = self._slot_of.get(rid)
+        info = self._chain.get(rid)
+        if slot is None or info is None:
+            return None
+        ent = tier.get_spill(rid)
+        if ent is None:
+            return None
+        toks = info["tokens"]
+        ps = self.page_size
+        n = min(int(ent.hi), len(toks) - 1 if toks else 0)
+        if align > 1:
+            n -= n % align
+        if n <= 0 or ent.tokens[:n] != toks[:n]:
+            tier.drop_spill(rid)  # stale (rid reuse / changed feed)
+            return None
+        cur = int(info["written_hi"])
+        if n <= cur:
+            tier.drop_spill(rid)  # prefix hits already cover the span
+            return None
+        try:
+            k_lo, k_hi = cur // ps, (n - 1) // ps
+            for k in range(k_lo, k_hi + 1):
+                if not ent.pages[k].verify():
+                    self.restore_failures += 1
+                    raise HostTierCorruption(
+                        f"rid {rid}: host page {k} failed its checksum "
+                        "on restore")
+            restored = n
+            pages_up, nbytes = 0, 0
+            try:
+                for k in range(k_lo, k_hi + 1):
+                    pid = int(self._table[slot, k])
+                    exclusive = (pid != self.scratch_pid
+                                 and self._req_refs[pid] == 1
+                                 and self._idx_refs[pid] == 0)
+                    if not exclusive:
+                        # shared prefix page / index page / unmapped:
+                        # land the upload on a fresh private page
+                        dst = self._alloc_page()
+                        self._unmap(slot, k)
+                        self._map(slot, k, dst)
+                        pid = dst
+                    self._write_page(pid, ent.pages[k])
+                    pages_up += 1
+                    nbytes += ent.pages[k].nbytes
+            except PagePoolExhausted:
+                restored = min(n, k * ps)
+                if align > 1:
+                    restored -= restored % align
+                if restored <= cur:
+                    return None  # nothing gained; recompute covers it
+            info["written_hi"] = max(cur, restored)
+            gained = max(restored - cur, 0)
+            self.pages_restored += pages_up
+            self.swap_bytes += nbytes
+            self.recompute_tokens_saved += gained
+            return {"restored_tokens": int(restored), "pages": pages_up,
+                    "nbytes": nbytes, "tokens_saved": int(gained)}
+        finally:
+            tier.drop_spill(rid)
+
+    def has_spill(self, rid: int) -> bool:
+        return (self.host_tier is not None
+                and int(rid) in self.host_tier._spills)
+
+    def drop_spill(self, rid: int) -> None:
+        if self.host_tier is not None:
+            self.host_tier.drop_spill(rid)
+
+    def adopt_spills(self, other, rids: Sequence[int]) -> int:
+        """Move ``rids``' spilled pages from another allocator's host
+        tier onto this one (migration readmission, fleet failover) —
+        only when the swap signatures match exactly; a shape-mismatched
+        successor recomputes.  Attaches a tier here if absent (capacity
+        inherited).  Returns the number of spills moved."""
+        src = getattr(other, "host_tier", None)
+        if src is None or other is self:
+            return 0
+        sig = self.swap_signature()
+        if src.signature != sig:
+            return 0
+        if self.host_tier is None:
+            self.host_tier = HostPageTier(src.capacity_bytes)
+        self.host_tier.signature = sig
+        moved = 0
+        for rid in rids:
+            s = src.pop_spill(int(rid))
+            if s is not None and self.host_tier.put_spill(int(rid), s):
+                moved += 1
+        return moved
+
+    def _promote_full(self, key: Tuple,
+                      want: Tuple[int, ...]) -> Optional[_Entry]:
+        """Re-register a demoted index page from the host tier (bind's
+        hit-scan miss path).  Never evicts to make room — promotion into
+        a full pool would recurse into demotion; a free page must exist
+        or the bind just recomputes."""
+        tier = self.host_tier
+        rec = tier.get_demoted(key)
+        if rec is None or rec.tokens != want:
+            return None
+        if not self._free:
+            return None
+        if not rec.page.verify():
+            tier.drop_demoted(key)
+            self.restore_failures += 1
+            return None
+        pid = self._free.pop()
+        self._write_page(pid, rec.page)
+        self._register_entry(key, pid, rec.tokens, rec.protected)
+        e = self._entries.get(key)
+        if e is None or e.pid != pid:  # registration refused (page keyed)
+            self._free.append(pid)
+            return None
+        tier.drop_demoted(key)
+        self.pages_restored += 1
+        self.swap_bytes += rec.page.nbytes
+        self.recompute_tokens_saved += self.page_size
+        return e
+
     # ---- capacity / headroom, page-granular ---------------------------
     @property
     def capacity_tokens(self) -> int:
@@ -594,7 +1059,14 @@ class PagedKVAllocator(KVAllocator):
             "cow_copies": self.cow_copies,
             "pages_evicted": self.pages_evicted,
             "pages_mapped_total": self.pages_mapped,
+            "pages_spilled": self.pages_spilled,
+            "pages_restored": self.pages_restored,
+            "swap_bytes": self.swap_bytes,
+            "restore_failures": self.restore_failures,
+            "recompute_tokens_saved": self.recompute_tokens_saved,
         })
+        if self.host_tier is not None:
+            snap.update(self.host_tier.snapshot())
         return snap
 
     # ---- diagnostics ---------------------------------------------------

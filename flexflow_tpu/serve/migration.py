@@ -552,6 +552,16 @@ class MigrationController:
         new_rm._tstamps.update(rm._tstamps)  # admission fired once per rid
         if is_spec_mgr:
             new_rm.default_spec_mode = bool(spec_on)
+        # host-tier KV crosses the switch: the drain's preempts spilled
+        # every running request's pages into the incumbent's host tier —
+        # adopt them onto the successor's allocators so readmission
+        # restores instead of re-prefilling.  adopt_spills() moves
+        # entries ONLY when the swap signatures (page geometry + buffer
+        # shapes/dtypes) match; a reshaped candidate silently falls back
+        # to the r9 recompute feed, which the transplant above preserved.
+        for old_kv, new_kv in zip(self._allocators(rm),
+                                  self._allocators(new_rm)):
+            new_kv.adopt_spills(old_kv, live)
         return len(live)
 
     @staticmethod

@@ -112,6 +112,12 @@ class Request:
     # ``seq_len`` needs while the recompute prefill is in flight.
     prefill_src: Optional[List[int]] = None
     n_prefed: int = 0
+    # host-tier KV (serve/kv_paged.py): True while this binding's cache
+    # was (partly) restored from a host-tier spill instead of recomputed.
+    # Once the catch-up prefill completes, the lifecycle scan retires the
+    # recompute feed early (prefill_src is dead weight the moment the
+    # cache is whole) — only terminal paths dropped it before.
+    kv_restored: bool = False
     # memory observability (serve/kv_allocator.py): peak committed-KV bytes
     # this request held across its slot bindings — stamped by the
     # allocator's release() on every slot-leaving path, carried on finish
@@ -244,6 +250,14 @@ class RequestManager:
                     "kv_headroom_frac, which gates in position units)")
         self.injector = fault_injector
         im.fault_injector = fault_injector
+        # host-tier KV spill/restore (serve/kv_paged.py): a positive
+        # ``host_tier_bytes`` attaches the bounded host-DRAM tier under
+        # the PAGED allocator — preemption/eviction then spill pages
+        # instead of dropping them, and readmission restores (checksum-
+        # verified) instead of re-prefilling.  No-op for the
+        # slot-contiguous allocator (attach_host_tier returns None there).
+        if kv is not None and self.res.host_tier_bytes:
+            kv.attach_host_tier(self.res.host_tier_bytes)
         # deadline/TTL clock — serve_with_arrivals swaps in its loop clock
         # for its duration so virtual-clock tests stay hermetic; _sleep is
         # the retry backoff's wait (injectable for the same reason)
@@ -671,6 +685,12 @@ class RequestManager:
         self._pending_since.pop(req.rid, None)
         self._release_slot(req)
         req.prefill_src = None  # recompute feed is dead weight once terminal
+        kv = getattr(self.im, "kv", None)
+        if kv is not None and kv.host_tier is not None:
+            # a terminal request's host-tier pages are garbage too — drop
+            # them now instead of waiting for the tier's LRU (the no-leak
+            # contract extends to the host tier per terminal outcome)
+            kv.drop_spill(req.rid)
         req.status = status
         req.outcome = OUTCOMES[status]
         if status is RequestStatus.REJECTED:
@@ -719,6 +739,20 @@ class RequestManager:
         long serving sessions.
         """
         live = [self.requests[r] for r in self.pending] + self._active()
+        # host-tier satellite: a restored request that finished its
+        # (shortened) catch-up prefill retires the recompute feed HERE —
+        # before this, only terminal paths dropped ``prefill_src``
+        # (_terminate), so a swap-restored request would carry a
+        # dead-weight prompt+generated copy for its whole decode.  The
+        # rebase is seq_len-invariant: ``prefill_offset - n_prefed`` is
+        # exactly the prompt-only offset the unpreempted run would hold.
+        for r in live:
+            if (r.kv_restored and r.prefill_src is not None
+                    and r.prefill_offset >= len(r.prefill_src)):
+                r.prefill_offset -= r.n_prefed
+                r.n_prefed = 0
+                r.prefill_src = None
+                r.kv_restored = False
         expirable = [r for r in live
                      if r.cancel_requested or r.deadline_s is not None]
         if not expirable:
@@ -735,22 +769,28 @@ class RequestManager:
         """Evict a running request, releasing its slot + KV immediately.
 
         The request re-enters the pending queue (status ``PREEMPTED``) and
-        on readmission RE-PREFILLS ``prompt + generated`` — recovery is
-        recompute-based, never KV-swap — after which its served tokens are
-        bit-identical to an unpreempted run for greedy AND seeded sampling
-        (the per-request sample-key schedule keys on (rid, token index)
-        only; pinned by tests/test_resilience.py, incl. int8 KV).
+        on readmission RE-PREFILLS ``prompt + generated`` — after which
+        its served tokens are bit-identical to an unpreempted run for
+        greedy AND seeded sampling (the per-request sample-key schedule
+        keys on (rid, token index) only; pinned by
+        tests/test_resilience.py, incl. int8 KV).  With a host tier
+        attached, the victim's written pages spill to host DRAM first:
+        readmission then restores them and recomputes only the unspilled
+        tail — same bit-identity contract, O(transfer) instead of
+        O(prefill).
         """
         req = self.requests[rid]
         if req.status not in (RequestStatus.PREFILLING,
                               RequestStatus.DECODING):
             raise ValueError(
                 f"cannot preempt request {rid} in status {req.status.name}")
+        self._kv_spill(req, getattr(self.im, "kv", None))
         self._release_slot(req)
         req.prefill_src = list(req.prompt) + list(req.generated)
         req.n_prefed = len(req.generated)
         req.prefill_offset = 0
         req.starved_steps = 0
+        req.kv_restored = False
         req.status = RequestStatus.PREEMPTED
         req.preemptions += 1
         self.pending.append(rid)
@@ -1969,6 +2009,15 @@ class RequestManager:
         cached = int(info.get("cached_tokens", 0))
         if cached:
             req.prefill_offset = cached
+        # host-tier readmission: upload this rid's spilled pages onto the
+        # freshly-bound row and resume the prefill at the restored write
+        # frontier — recompute covers only the unrestored tail.  Prefix
+        # hits already below the frontier cost nothing extra (restore
+        # skips the span bind covered).
+        restored = self._kv_restore(req, kv, align)
+        if restored > cached:
+            req.prefill_offset = restored
+            req.kv_restored = True
         tel = self.telemetry
         if tel.enabled:
             if cached:
@@ -1976,6 +2025,97 @@ class RequestManager:
                                      pages=int(info.get("hit_pages", 0)))
             else:
                 tel.prefix_cache_miss(req.trace_id)
+
+    def _kv_spill(self, req: Request, kv) -> None:
+        """Copy a victim's written pages to the host tier BEFORE its slot
+        releases (every page-leaving path funnels through preempt()).
+        Guarded by the retry policy at the ``kv_swap_out:<rid>`` chaos
+        site; a fault schedule that exhausts the budget just skips the
+        spill — the r9 recompute feed still covers recovery
+        bit-identically, so a failed spill can never corrupt, only cost.
+        """
+        if kv is None or kv.host_tier is None or req.slot < 0:
+            return
+        site = f"kv_swap_out:{req.rid}"
+        tokens = list(req.prompt) + list(req.generated)
+        pol = self.res.retry
+        tel = self.telemetry
+        attempt = 0
+        while True:
+            try:
+                if self.injector is not None:
+                    self.injector.maybe_fail(site)
+                info = kv.spill(req.rid, tokens)
+                break
+            except TransientServeError as e:
+                if tel.enabled:
+                    tel.fault_observed(site, detail=str(e))
+                if attempt >= pol.max_retries:
+                    kv.drop_spill(req.rid)
+                    return
+                attempt += 1
+                delay = pol.backoff(attempt)
+                if tel.enabled:
+                    tel.dispatch_retry(site, attempt=attempt,
+                                       backoff_s=delay)
+                if delay > 0:
+                    self._sleep(delay)
+        if info and tel.enabled:
+            tel.kv_spilled(req.trace_id, pages=info["pages"],
+                           nbytes=info["nbytes"], tokens=info["tokens"])
+
+    def _kv_restore(self, req: Request, kv, align: int) -> int:
+        """Upload ``req``'s spilled pages back after its readmission bind;
+        returns the restored write frontier (0 = nothing restored — the
+        recompute feed covers everything, bit-identically).  Guarded at
+        the ``kv_swap_in:<rid>`` chaos site under the retry policy;
+        :class:`~.kv_paged.HostTierCorruption` (checksum mismatch) is NOT
+        retried — the host copy itself is damaged, so the entry drops and
+        recompute takes over."""
+        if kv is None or kv.host_tier is None or not kv.has_spill(req.rid):
+            return 0
+        from .kv_paged import HostTierCorruption
+
+        site = f"kv_swap_in:{req.rid}"
+        pol = self.res.retry
+        tel = self.telemetry
+        attempt = 0
+        while True:
+            try:
+                if self.injector is not None:
+                    self.injector.maybe_fail(site)
+                info = kv.restore(req.rid, align=align)
+                break
+            except HostTierCorruption as e:
+                kv.drop_spill(req.rid)
+                if tel.enabled:
+                    tel.kv_restore_failed(req.trace_id, reason=str(e))
+                return 0
+            except TransientServeError as e:
+                if tel.enabled:
+                    tel.fault_observed(site, detail=str(e))
+                if attempt >= pol.max_retries:
+                    kv.drop_spill(req.rid)
+                    if tel.enabled:
+                        tel.kv_restore_failed(
+                            req.trace_id,
+                            reason=f"retry budget exhausted at {site}")
+                    return 0
+                attempt += 1
+                delay = pol.backoff(attempt)
+                if tel.enabled:
+                    tel.dispatch_retry(site, attempt=attempt,
+                                       backoff_s=delay)
+                if delay > 0:
+                    self._sleep(delay)
+        if not info:
+            return 0
+        if tel.enabled:
+            tel.kv_restored(req.trace_id, pages=info["pages"],
+                            nbytes=info["nbytes"],
+                            tokens_resumed=info["restored_tokens"],
+                            tokens_saved=info["tokens_saved"])
+        return int(info["restored_tokens"])
 
     def _kv_prepare(self, spans, kv=None) -> None:
         """Pre-dispatch page preparation for every (rid, lo, hi) cache
@@ -2125,6 +2265,28 @@ class RequestManager:
         if tel.enabled:
             for cname, cnt in deferred.items():
                 tel.lane_deferred(cname, count=cnt)
+        # --- SPILL: the rung between DEFER and DEGRADE -----------------
+        # before capping or shedding anything, push degradable decoding
+        # requests' pages to the host tier while KV pressure holds — each
+        # preempt() below spills first (tier attached), so the freed
+        # pages cost a swap on readmission, not a recompute, and the
+        # bit-identical-prefix contract is untouched (preemption already
+        # carries it).  Only fires with a tier attached and real page
+        # pressure; the level walk/hysteresis pins stay as they are
+        # because SPILL is an action DEFER_BATCH and above carry, not a
+        # new enum member (fleet.py hardcodes level comparisons).
+        if (kv is not None and kv.host_tier is not None
+                and occ >= bo.config.kv_pressure_frac):
+            victims = [r for r in self._active()
+                       if r.status is RequestStatus.DECODING
+                       and bo.spills(r.slo_class)
+                       and r.preemptions < self.res.max_preemptions]
+            victims.sort(key=lambda r: (r.priority, -r.rid))
+            cap_toks = max(kv.capacity_tokens, 1)
+            for req in victims:
+                if kv.live_tokens() / cap_toks < bo.config.kv_pressure_frac:
+                    break
+                self.preempt(req.rid)
         for req in list(self._active()):
             if bo.sheds_live(req.slo_class):
                 # CRITICAL_ONLY: evict and shed even slotted degradable

@@ -99,6 +99,15 @@ class ResilienceConfig:
       ``"requeue"`` recovers the affected requests by preempt-and-recompute
       (bounded by ``max_requeues``, then ``FAILED``); ``"fail"`` fails them
       immediately.  Either way the engine keeps serving everyone else.
+    * ``host_tier_bytes``: capacity of the host-DRAM KV spill tier under
+      the PAGED allocator (0 = off, the recompute-only status quo).  When
+      on, every page-leaving path — preemption, page-pressure eviction,
+      migration drain, brownout SPILL — copies the victim's written pages
+      to host first, and readmission restores them (checksum-verified)
+      instead of re-prefilling; a failed/corrupt restore falls back to
+      the recompute feed bit-identically.  Swap transfers are guarded by
+      the same ``retry`` policy at the ``kv_swap_out:``/``kv_swap_in:``
+      fault sites.
     """
 
     max_pending: Optional[int] = None
@@ -110,6 +119,7 @@ class ResilienceConfig:
     max_preemptions: int = 4
     on_dispatch_failure: str = "requeue"
     max_requeues: int = 2
+    host_tier_bytes: int = 0
     retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
 
     def __post_init__(self):
@@ -141,7 +151,11 @@ class FaultInjector:
     router's per-replica sites (``fleet_dispatch:<name>`` — router →
     replica connectivity, consulted before every replica tick — and
     ``fleet_health:<name>``, the quarantine re-probe; see
-    ``serve/fleet.py``'s health state machine).
+    ``serve/fleet.py``'s health state machine).  The host-KV swap paths
+    add ``kv_swap_out:<rid>`` / ``kv_swap_in:<rid>`` (spill capture and
+    restore upload) — a fault there degrades to recompute, never to
+    corruption, because the host copy is only trusted after its checksum
+    verifies.
     """
 
     def __init__(self, seed: int = 0, p: float = 0.0,

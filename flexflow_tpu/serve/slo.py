@@ -320,6 +320,20 @@ class BrownoutController:
         return (cls is not None and cls.shed_policy == "brownout"
                 and self.level >= BrownoutLevel.DEFER_BATCH)
 
+    def spills(self, cls_name: str) -> bool:
+        """SPILL semantics — the rung between DEFER and DEGRADE: may this
+        class's decoding requests have their KV pages pushed to the host
+        tier (preempt-with-spill) to relieve page pressure?  Carried by
+        DEFER_BATCH and above as an ACTION, not a new ladder level: the
+        level walk, its hysteresis pins, and fleet.py's hardcoded level
+        comparisons stay untouched, and readmission restores the pages
+        (bit-identical-prefix contract — preemption already carries it).
+        Only degradable classes spill; latency-critical work keeps its
+        pages hot."""
+        cls = self._cls(cls_name)
+        return (cls is not None and cls.degradable
+                and self.level >= BrownoutLevel.DEFER_BATCH)
+
     def degrades(self, cls_name: str) -> bool:
         """DEGRADE semantics: spec off + output cap for this class?"""
         cls = self._cls(cls_name)

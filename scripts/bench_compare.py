@@ -60,6 +60,7 @@ def _counter_keys():
             HOST_TICK_REGRESSION_COUNTERS,
             REPLAY_REGRESSION_COUNTERS,
             SLO_REGRESSION_COUNTERS,
+            TIER_REGRESSION_COUNTERS,
             TRACE_REGRESSION_COUNTERS,
         )
 
@@ -79,11 +80,17 @@ def _counter_keys():
         # bit-identically.  telemetry_events_dropped hardens trace
         # drops: the ring buffer silently losing events was only a
         # stderr warning in trace_report — here it fails the diff.
+        # kv_restore_failures (serve/kv_paged.py host tier) joins at
+        # exact-zero too: a clean-path restore degrading to recompute is
+        # correct-but-worse, so any increase on the same seeded workload
+        # is a regression (the spill/restore volume counters stay out —
+        # their direction depends on the pressure mix, not on health).
         _COUNTER_KEYS = frozenset(WORK_COUNTERS) \
             | frozenset(FLEET_REGRESSION_COUNTERS) \
             | frozenset(SLO_REGRESSION_COUNTERS) \
             | frozenset(HOST_TICK_REGRESSION_COUNTERS) \
             | frozenset(REPLAY_REGRESSION_COUNTERS) \
+            | frozenset(TIER_REGRESSION_COUNTERS) \
             | frozenset(TRACE_REGRESSION_COUNTERS)
     return _COUNTER_KEYS
 

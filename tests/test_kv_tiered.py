@@ -1,0 +1,405 @@
+"""Host-tier KV spill/restore tests (ISSUE 20): preemption spills pages
+to host DRAM, readmission restores them checksum-verified, and every
+failure mode falls back to the r9 recompute feed bit-identically.
+
+The load-bearing contracts:
+
+* a preempt-spill-restore round trip is BIT-IDENTICAL to a run that was
+  never preempted — greedy and seeded sampling, bf16 and int8 KV, tp and
+  pp2, with and without paged-prefix sharing — because restore uploads
+  the exact bytes the victim wrote and resumes the prefill at the
+  restored frontier;
+* a successful restore RETIRES the recompute feed: once the restored
+  request's prefill catches up, ``prefill_src`` drops mid-serve (the
+  satellite contract — the feed is dead weight, not insurance);
+* chaos at either swap site (``kv_swap_out:`` / ``kv_swap_in:``) and a
+  corrupt host page all degrade to pure recompute with identical
+  tokens — a damaged or missing host copy can cost, never corrupt;
+* the tier itself is bounded: ONE LRU across spills and demoted index
+  pages, admission evicts to fit, an oversized unit is refused, and no
+  terminal outcome leaks a spill entry or a page attribution.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs import Telemetry
+from flexflow_tpu.serve import (
+    FaultInjector,
+    GenerationConfig,
+    RequestManager,
+    RequestStatus,
+    ResilienceConfig,
+)
+from flexflow_tpu.serve.kv_paged import (
+    HostPageTier,
+    _Demoted,
+    _HostPage,
+    _Spill,
+)
+from flexflow_tpu.serve.slo import (
+    BrownoutController,
+    BrownoutLevel,
+    SLOClass,
+    SLOPolicy,
+)
+
+from test_resilience import TriggerClock, quiet
+from test_serve import make_im
+from test_pp_serve import make_pp_im
+
+pytestmark = pytest.mark.tiered
+
+HOST_TIER_BYTES = 64 << 20
+
+# long enough that the restore span survives the pallas prefill-tile
+# alignment clamp (restore keeps n - n % tile tokens; the feed at the
+# earliest trigger point is prompt + 2 generated, so 15 prompt tokens
+# guarantee at least one full tile/page below the clamp)
+PROMPT_LONG = [3, 11, 25, 40, 7, 9, 2, 6, 13, 5, 8, 4, 10, 12, 14]
+PROMPTS = [PROMPT_LONG, [2, 4, 6]]
+
+
+def _tiered_res(**kw):
+    return ResilienceConfig(host_tier_bytes=HOST_TIER_BYTES, **kw)
+
+
+_WANT = {}
+
+
+def _want(key, im, gen, prompts):
+    """The unpreempted reference stream, memoized per (config, gen,
+    prompts) — every fallback test compares against the SAME oracle, so
+    recomputing it per test would only burn suite time.  Callers get a
+    freshly re-initialized im either way (make_im re-inits per call)."""
+    k = (key, gen.max_new_tokens, gen.temperature, gen.top_p, gen.seed,
+         tuple(map(tuple, prompts)))
+    if k not in _WANT:
+        _WANT[k] = RequestManager(im, gen).generate(prompts)
+        im.reset()
+    return _WANT[k]
+
+
+def _tiered_im(kv_dtype=None):
+    # the exact paged configs test_kv_paged already compiled (cache
+    # reuse keeps tier-1 time flat)
+    return (make_im(max_tokens=8, max_requests=2, max_seq=32,
+                    use_pallas=True, kv_dtype="int8", kv_page_size=16)
+            if kv_dtype else make_im(max_seq=64, kv_page_size=16))
+
+
+def _flush_index(kv):
+    """Evict every prefix-index entry — the churn a busy pool would
+    cause between preempt and readmission.  Without it the victim's
+    rebind prefix-hits its OWN just-released pages and restore has
+    nothing left to cover (correct, but it would leave the upload path
+    untested)."""
+    for key in list(kv._entries):
+        kv._drop_entry(key)
+
+
+def _serve_with_spill_restore(im, gen, prompts, preempt_rid, res=None,
+                              injector=None, after_preempt=None,
+                              telemetry=None):
+    """Serve ``prompts``, preempting ``preempt_rid`` mid-decode and
+    flushing the prefix index so readmission must go through the
+    host-tier restore (or its fallback) rather than a prefix hit."""
+    rm = quiet(RequestManager(im, gen, resilience=res or _tiered_res(),
+                              fault_injector=injector, telemetry=telemetry))
+    kv = im.kv
+    assert kv.host_tier is not None, "host_tier_bytes did not attach a tier"
+    # a cached im may carry another test's tier entries under reused rids
+    kv.host_tier._spills.clear()
+    kv.host_tier._demoted.clear()
+    arrivals = [(0.0, p, gen.max_new_tokens) for p in prompts]
+    rm.scan_chunk = 2
+
+    def ready():
+        req = rm.requests.get(preempt_rid)
+        return (req is not None
+                and req.status is RequestStatus.DECODING
+                and 2 <= len(req.generated) < gen.max_new_tokens - 1)
+
+    def fire():
+        rm.preempt(preempt_rid)
+        _flush_index(kv)
+        if after_preempt is not None:
+            after_preempt(rm)
+
+    clock = TriggerClock(ready, fn=fire)
+    records = rm.serve_with_arrivals(arrivals, clock=clock)
+    assert clock.fired, "preempt trigger never armed"
+    return rm, records
+
+
+def _counters(kv):
+    return (kv.pages_spilled, kv.pages_restored, kv.recompute_tokens_saved,
+            kv.restore_failures)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix: spill/restore == never-preempted
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_spill_restore_bit_identical_greedy(kv_dtype):
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _tiered_im(kv_dtype)
+    want = _want(kv_dtype, im, gen, PROMPTS)
+    rm, records = _serve_with_spill_restore(im, gen, PROMPTS, preempt_rid=0)
+    kv = im.kv
+    assert rm.requests[0].preemptions == 1
+    got = [records[r]["tokens"] for r in sorted(records)]
+    assert got == want, "spill/restore diverged from the unpreempted run"
+    assert all(r["outcome"] == "ok" for r in records.values())
+    # the round trip actually moved pages (not a silent recompute)
+    assert kv.pages_restored > 0 and kv.recompute_tokens_saved > 0
+    assert not kv.host_tier._spills, "restore must consume the spill entry"
+    assert kv.attributed_rids() == []
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_spill_restore_bit_identical_seeded_sampling(kv_dtype):
+    # seeded sampling is the stronger gate: the restored stream must keep
+    # the per-request (rid, token-index) key schedule byte-for-byte
+    gen = GenerationConfig(max_new_tokens=10, temperature=0.8, top_p=0.9,
+                           seed=11)
+    im = _tiered_im(kv_dtype)
+    want = _want(kv_dtype, im, gen, PROMPTS)
+    rm, records = _serve_with_spill_restore(im, gen, PROMPTS, preempt_rid=0)
+    assert rm.requests[0].preemptions == 1
+    got = [records[r]["tokens"] for r in sorted(records)]
+    assert got == want, "restored sampled stream diverged"
+    assert im.kv.pages_restored > 0
+
+
+def test_spill_restore_bit_identical_pp2():
+    # pp2: one spill page carries every stage's K/V blocks; restore must
+    # land each block back on its own stage's buffers
+    gen = GenerationConfig(max_new_tokens=8)
+    pim = make_pp_im({"pp": 2}, kv_page_size=16)
+    want = _want("pp2", pim, gen, PROMPTS)
+    pim2 = make_pp_im({"pp": 2}, kv_page_size=16)
+    rm, records = _serve_with_spill_restore(pim2, gen, PROMPTS,
+                                            preempt_rid=0)
+    assert rm.requests[0].preemptions == 1
+    got = [records[r]["tokens"] for r in sorted(records)]
+    assert got == want, "pp2 spill/restore diverged"
+    assert pim2.kv.pages_restored > 0
+
+
+def test_spill_restore_with_prefix_sharing():
+    # the victim's early pages are SHARED (paged-prefix COW) with a live
+    # request — restore must upload onto fresh private pages, never
+    # scribble over the survivor's mapped prefix
+    shared = list(range(1, 17))  # one full 16-token page + tail
+    prompts = [shared + [30, 31], shared + [40, 41, 42]]
+    gen = GenerationConfig(max_new_tokens=8)
+    im = _tiered_im()
+    want = _want(None, im, gen, prompts)
+    rm, records = _serve_with_spill_restore(im, gen, prompts, preempt_rid=0)
+    assert rm.requests[0].preemptions == 1
+    got = [records[r]["tokens"] for r in sorted(records)]
+    assert got == want, "restore over a shared prefix diverged"
+    assert im.kv.pages_restored > 0
+    assert im.kv.attributed_rids() == []
+
+
+def test_restore_retires_recompute_feed_mid_serve():
+    # satellite contract: once the restored request's prefill catches up,
+    # prefill_src drops DURING decode — not only at the terminal path
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _tiered_im()
+    want = _want(None, im, gen, PROMPTS)
+    seen = []
+
+    class ProbeClock(TriggerClock):
+        def __call__(self):
+            t = super().__call__()
+            req = rm_box[0].requests.get(0) if rm_box else None
+            if (self.fired and req is not None
+                    and req.status is RequestStatus.DECODING
+                    and req.preemptions == 1):
+                seen.append((req.kv_restored, req.prefill_src is None,
+                             req.n_prefed))
+            return t
+
+    rm_box = []
+    rm = quiet(RequestManager(im, gen, resilience=_tiered_res()))
+    rm_box.append(rm)
+    im.kv.host_tier._spills.clear()
+    rm.scan_chunk = 2
+
+    def ready():
+        req = rm.requests.get(0)
+        return (req is not None and req.status is RequestStatus.DECODING
+                and 2 <= len(req.generated) < gen.max_new_tokens - 1)
+
+    clock = ProbeClock(ready, fn=lambda: (rm.preempt(0),
+                                          _flush_index(im.kv)))
+    records = rm.serve_with_arrivals(
+        [(0.0, p, gen.max_new_tokens) for p in PROMPTS], clock=clock)
+    assert clock.fired and im.kv.pages_restored > 0
+    assert [records[r]["tokens"] for r in sorted(records)] == want
+    assert any(not restored and src_gone and n == 0
+               for restored, src_gone, n in seen), (
+        "prefill_src never retired while the restored request was "
+        f"still decoding (observations: {seen})")
+
+
+# ---------------------------------------------------------------------------
+# chaos at the swap sites + corruption: fallback-to-recompute equivalence
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_swap_out_fault_falls_back_to_pure_recompute():
+    # every spill attempt faults (retry budget exhausts) -> nothing in
+    # the tier -> readmission is the plain r9 recompute, bit-identical
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _tiered_im()
+    want = _want(None, im, gen, PROMPTS)
+    inj = FaultInjector(seed=0, p=0.0, p_by_site={"kv_swap_out": 1.0})
+    k0 = _counters(im.kv)
+    rm, records = _serve_with_spill_restore(im, gen, PROMPTS, preempt_rid=0,
+                                            injector=inj)
+    kv = im.kv
+    assert inj.injected > 0, "swap-out chaos never fired"
+    assert rm.requests[0].preemptions == 1
+    assert [records[r]["tokens"] for r in sorted(records)] == want
+    spilled, restored = kv.pages_spilled - k0[0], kv.pages_restored - k0[1]
+    assert spilled == 0 and restored == 0, (
+        "a faulted spill must skip the tier entirely")
+    assert not kv.host_tier._spills
+
+
+@pytest.mark.chaos
+def test_swap_in_fault_falls_back_to_recompute():
+    # the spill lands, but every restore attempt faults -> the entry
+    # drops, telemetry records the failure, recompute covers recovery
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _tiered_im()
+    want = _want(None, im, gen, PROMPTS)
+    inj = FaultInjector(seed=0, p=0.0, p_by_site={"kv_swap_in": 1.0})
+    tel = Telemetry()
+    k0 = _counters(im.kv)
+    rm, records = _serve_with_spill_restore(im, gen, PROMPTS, preempt_rid=0,
+                                            injector=inj, telemetry=tel)
+    kv = im.kv
+    assert inj.injected > 0, "swap-in chaos never fired"
+    assert [records[r]["tokens"] for r in sorted(records)] == want
+    assert kv.pages_spilled - k0[0] > 0, "the spill itself must succeed"
+    assert kv.pages_restored - k0[1] == 0
+    assert tel.metrics.counter("kv_restore_failures").value >= 1
+    assert not kv.host_tier._spills, "a failed restore must drop the entry"
+
+
+@pytest.mark.chaos
+def test_corrupt_host_page_detected_and_recomputed():
+    # flip one byte of the spilled copy without updating the checksum:
+    # restore must detect it BEFORE the table mutates and fall back
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _tiered_im()
+    want = _want(None, im, gen, PROMPTS)
+    k0 = _counters(im.kv)
+
+    def corrupt(rm):
+        spill = rm.im.kv.host_tier._spills[0]
+        spill.pages[-1].corrupt_for_test()
+
+    rm, records = _serve_with_spill_restore(im, gen, PROMPTS, preempt_rid=0,
+                                            after_preempt=corrupt)
+    kv = im.kv
+    assert [records[r]["tokens"] for r in sorted(records)] == want, (
+        "corruption fallback diverged from the unpreempted run")
+    assert kv.restore_failures - k0[3] == 1, "checksum miss went uncounted"
+    assert kv.pages_restored - k0[1] == 0
+    assert not kv.host_tier._spills
+    assert kv.attributed_rids() == []
+
+
+def test_terminal_outcome_drops_spill_no_leak():
+    # preempt then cancel: the rid goes terminal WITHOUT readmission, so
+    # the terminal path must drop the spill entry (and the survivor's
+    # stream is untouched)
+    gen = GenerationConfig(max_new_tokens=10)
+    im = _tiered_im()
+    want = _want(None, im, gen, PROMPTS)
+    rm, records = _serve_with_spill_restore(
+        im, gen, PROMPTS, preempt_rid=0,
+        after_preempt=lambda rm: rm.cancel(0))
+    kv = im.kv
+    assert records[0]["outcome"] != "ok"
+    assert records[1]["tokens"] == want[1], "cancel leaked into a survivor"
+    assert not kv.host_tier._spills, "terminal outcome leaked a spill entry"
+    assert kv.attributed_rids() == []
+
+
+# ---------------------------------------------------------------------------
+# HostPageTier unit behavior: bound, LRU order, refusal, checksum
+# ---------------------------------------------------------------------------
+def _hp(nbytes=32, fill=0.0):
+    blk = np.full(nbytes // 4, fill, np.float32)
+    return _HostPage([blk], zlib.crc32(blk.tobytes(), 0), blk.nbytes)
+
+
+def _spill_unit(nbytes=32):
+    return _Spill([_hp(nbytes)], [1, 2, 3], 3)
+
+
+def test_host_tier_lru_bound_and_eviction_order():
+    tier = HostPageTier(100)
+    for rid in range(3):
+        assert tier.put_spill(rid, _spill_unit())
+    assert tier.bytes_used == 96 and tier.pages_held() == 3
+    # admission evicts the least-recently-used unit to fit
+    assert tier.put_spill(3, _spill_unit())
+    assert tier.evictions == 1 and 0 not in tier._spills
+    assert tier.bytes_used <= tier.capacity_bytes
+    # a get refreshes LRU, so rid 1 survives the next eviction
+    tier.get_spill(1)
+    assert tier.put_spill(4, _spill_unit())
+    assert 1 in tier._spills and 2 not in tier._spills
+    # an oversized unit is refused outright, never partially held
+    used = tier.bytes_used
+    assert not tier.put_spill(9, _spill_unit(nbytes=128))
+    assert 9 not in tier._spills and tier.bytes_used == used
+    # demoted index pages share the SAME budget and LRU
+    assert tier.put_demoted(("f", (1, 2)), _Demoted(_hp(), (1, 2), 16))
+    assert tier.bytes_used <= tier.capacity_bytes
+    assert tier.evictions >= 2
+    snap = tier.snapshot()
+    assert snap["host_bytes"] == tier.bytes_used
+    assert snap["host_spilled_requests"] == len(tier._spills)
+
+
+def test_host_page_checksum_detects_corruption():
+    page = _hp(fill=7.0)
+    assert page.verify()
+    page.corrupt_for_test()
+    assert not page.verify()
+    # a fresh read-back of uncorrupted bytes still verifies (crc chains
+    # over every block, not just the first)
+    multi = _HostPage([np.ones(4, np.float32), np.zeros(4, np.int8)], 0, 20)
+    multi.crc = zlib.crc32(multi.blocks[1].tobytes(),
+                           zlib.crc32(multi.blocks[0].tobytes(), 0))
+    assert multi.verify()
+
+
+# ---------------------------------------------------------------------------
+# brownout SPILL action (satellite): the rung between DEFER and DEGRADE
+# ---------------------------------------------------------------------------
+def test_brownout_spill_action_gating():
+    pol = SLOPolicy([
+        SLOClass("latency_critical", priority_band=1000, shed_policy="never"),
+        SLOClass("batch", shed_policy="brownout"),
+    ], default_class="batch")
+    bo = BrownoutController(pol)
+    assert not bo.spills("batch"), "NORMAL must not spill anyone"
+    bo.level = BrownoutLevel.DEFER_BATCH
+    assert bo.spills("batch"), "SPILL rides DEFER_BATCH and above"
+    assert not bo.spills("latency_critical"), (
+        "latency-critical work keeps its pages hot")
+    assert not bo.degrades("batch"), (
+        "SPILL must engage BELOW the DEGRADE rung")
+    bo.level = BrownoutLevel.CRITICAL_ONLY
+    assert bo.spills("batch") and not bo.spills("latency_critical")
